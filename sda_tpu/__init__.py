@@ -1,0 +1,19 @@
+"""sda_tpu — a TPU-native secure distributed aggregation framework.
+
+Capabilities of snipsco/sda (reference at /root/reference), re-based on
+JAX/XLA for the math plane:
+
+- ``protocol``: the wire contract (resources, schemes, service interface).
+- ``ops``: mod-p field math (NTT, Lagrange, RNG) as numpy + JAX kernels.
+- ``crypto``: masking / sharing / transport-encryption / signing schemes.
+- ``client``: participant / clerk / recipient role logic.
+- ``server``: orchestration server, stores, snapshot pipeline.
+- ``rest``: HTTP binding of the service seam (server + client proxy).
+- ``parallel``: the TPU aggregation fabric (mesh sharding, collectives).
+- ``cli``: ``sda`` (agent) and ``sdad`` (server daemon) command lines.
+
+Heavy dependencies (JAX, libsodium) are imported lazily by the modules that
+need them, so protocol-only use stays light.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,113 @@
+"""The SDA service interface — the single seam of the whole system.
+
+The 19 RPC methods of /root/reference/protocol/src/methods.rs as one abstract
+base class. The in-process server, the REST client proxy, and any future
+binding all implement this same interface, so protocol logic and tests are
+written once against it (the reference's key architectural property,
+SURVEY.md §1).
+
+Every method takes ``caller`` for access control; ``get_*`` methods return
+``None`` for missing resources.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+
+class SdaService(abc.ABC):
+    """Combined SDA service: agent, aggregation, participation, clerking,
+    and recipient methods (methods.rs:13-112)."""
+
+    # -- base ---------------------------------------------------------------
+
+    @abc.abstractmethod
+    def ping(self):
+        """Liveness check; returns Pong."""
+
+    # -- agents (methods.rs:31-50) -----------------------------------------
+
+    @abc.abstractmethod
+    def create_agent(self, caller, agent) -> None:
+        """Register an agent (caller must be the agent itself)."""
+
+    @abc.abstractmethod
+    def get_agent(self, caller, agent_id):
+        """Fetch an agent description; public."""
+
+    @abc.abstractmethod
+    def upsert_profile(self, caller, profile) -> None:
+        """Create or update the caller's public profile."""
+
+    @abc.abstractmethod
+    def get_profile(self, caller, owner_id):
+        """Fetch a public profile."""
+
+    @abc.abstractmethod
+    def create_encryption_key(self, caller, signed_key) -> None:
+        """Register a signed encryption key (caller must be the signer)."""
+
+    @abc.abstractmethod
+    def get_encryption_key(self, caller, key_id):
+        """Fetch a signed encryption key; public."""
+
+    # -- aggregations (methods.rs:53-64) -------------------------------------
+
+    @abc.abstractmethod
+    def list_aggregations(self, caller, filter: Optional[str] = None, recipient=None):
+        """Search aggregations by title substring and/or recipient."""
+
+    @abc.abstractmethod
+    def get_aggregation(self, caller, aggregation_id):
+        """Fetch an aggregation description."""
+
+    @abc.abstractmethod
+    def get_committee(self, caller, aggregation_id):
+        """Fetch the committee elected for an aggregation."""
+
+    # -- participation (methods.rs:68-73) ------------------------------------
+
+    @abc.abstractmethod
+    def create_participation(self, caller, participation) -> None:
+        """Submit a participation (caller must be the participant)."""
+
+    # -- clerking (methods.rs:76-84) -----------------------------------------
+
+    @abc.abstractmethod
+    def get_clerking_job(self, caller, clerk_id):
+        """Poll the durable queue for the clerk's next job, if any."""
+
+    @abc.abstractmethod
+    def create_clerking_result(self, caller, result) -> None:
+        """Push the result of a finished clerking job."""
+
+    # -- recipient (methods.rs:87-112) ----------------------------------------
+
+    @abc.abstractmethod
+    def create_aggregation(self, caller, aggregation) -> None:
+        """Create an aggregation (caller must be the recipient)."""
+
+    @abc.abstractmethod
+    def delete_aggregation(self, caller, aggregation_id) -> None:
+        """Delete all information regarding an aggregation."""
+
+    @abc.abstractmethod
+    def suggest_committee(self, caller, aggregation_id):
+        """Propose suitable committee members; returns list[ClerkCandidate]."""
+
+    @abc.abstractmethod
+    def create_committee(self, caller, committee) -> None:
+        """Elect the committee for an aggregation."""
+
+    @abc.abstractmethod
+    def get_aggregation_status(self, caller, aggregation_id):
+        """Poll aggregation status (participations, snapshots, readiness)."""
+
+    @abc.abstractmethod
+    def create_snapshot(self, caller, snapshot) -> None:
+        """Freeze a consistent subset of participations and build clerk jobs."""
+
+    @abc.abstractmethod
+    def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
+        """Fetch the collected clerk results + mask blob for a snapshot."""

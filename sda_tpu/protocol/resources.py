@@ -1,0 +1,393 @@
+"""Protocol resources — the REST objects of the SDA wire contract.
+
+Field names and order mirror /root/reference/protocol/src/resources.rs so the
+JSON wire format (and canonical signing bytes) match the reference's serde
+output byte for byte.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .helpers import Binary, Labelled, Signed
+from .ids import (
+    AgentId,
+    AggregationId,
+    ClerkingJobId,
+    EncryptionKeyId,
+    ParticipationId,
+    SnapshotId,
+    VerificationKeyId,
+)
+from .schemes import (
+    AdditiveEncryptionScheme,
+    Encryption,
+    EncryptionKey,
+    LinearMaskingScheme,
+    LinearSecretSharingScheme,
+    VerificationKey,
+)
+
+
+def _opt(value, f):
+    return None if value is None else f(value)
+
+
+@dataclass
+class Agent:
+    """Fundamental agent description (resources.rs:12-17)."""
+
+    id: AgentId
+    verification_key: Labelled  # Labelled[VerificationKeyId, VerificationKey]
+
+    def to_json(self):
+        return {
+            "id": self.id.to_json(),
+            "verification_key": self.verification_key.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=AgentId.from_json(obj["id"]),
+            verification_key=Labelled.from_json(
+                obj["verification_key"], VerificationKeyId, VerificationKey
+            ),
+        )
+
+
+@dataclass
+class Profile:
+    """Extended public profile of an agent (resources.rs:24-35)."""
+
+    owner: AgentId
+    name: Optional[str] = None
+    twitter_id: Optional[str] = None
+    keybase_id: Optional[str] = None
+    website: Optional[str] = None
+
+    def to_json(self):
+        return {
+            "owner": self.owner.to_json(),
+            "name": self.name,
+            "twitter_id": self.twitter_id,
+            "keybase_id": self.keybase_id,
+            "website": self.website,
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            owner=AgentId.from_json(obj["owner"]),
+            name=obj.get("name"),
+            twitter_id=obj.get("twitter_id"),
+            keybase_id=obj.get("keybase_id"),
+            website=obj.get("website"),
+        )
+
+
+def signed_encryption_key_from_json(obj) -> Signed:
+    """SignedEncryptionKey = Signed<Labelled<EncryptionKeyId, EncryptionKey>>."""
+    return Signed.from_json(
+        obj, lambda body: Labelled.from_json(body, EncryptionKeyId, EncryptionKey)
+    )
+
+
+@dataclass
+class Aggregation:
+    """Description of an aggregation (resources.rs:44-67)."""
+
+    id: AggregationId
+    title: str
+    vector_dimension: int
+    modulus: int
+    recipient: AgentId
+    recipient_key: EncryptionKeyId
+    masking_scheme: LinearMaskingScheme
+    committee_sharing_scheme: LinearSecretSharingScheme
+    recipient_encryption_scheme: AdditiveEncryptionScheme
+    committee_encryption_scheme: AdditiveEncryptionScheme
+
+    def to_json(self):
+        return {
+            "id": self.id.to_json(),
+            "title": self.title,
+            "vector_dimension": self.vector_dimension,
+            "modulus": self.modulus,
+            "recipient": self.recipient.to_json(),
+            "recipient_key": self.recipient_key.to_json(),
+            "masking_scheme": self.masking_scheme.to_json(),
+            "committee_sharing_scheme": self.committee_sharing_scheme.to_json(),
+            "recipient_encryption_scheme": self.recipient_encryption_scheme.to_json(),
+            "committee_encryption_scheme": self.committee_encryption_scheme.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=AggregationId.from_json(obj["id"]),
+            title=obj["title"],
+            vector_dimension=int(obj["vector_dimension"]),
+            modulus=int(obj["modulus"]),
+            recipient=AgentId.from_json(obj["recipient"]),
+            recipient_key=EncryptionKeyId.from_json(obj["recipient_key"]),
+            masking_scheme=LinearMaskingScheme.from_json(obj["masking_scheme"]),
+            committee_sharing_scheme=LinearSecretSharingScheme.from_json(
+                obj["committee_sharing_scheme"]
+            ),
+            recipient_encryption_scheme=AdditiveEncryptionScheme.from_json(
+                obj["recipient_encryption_scheme"]
+            ),
+            committee_encryption_scheme=AdditiveEncryptionScheme.from_json(
+                obj["committee_encryption_scheme"]
+            ),
+        )
+
+
+@dataclass
+class ClerkCandidate:
+    """Suggested clerk for an aggregation (resources.rs:74-79)."""
+
+    id: AgentId
+    keys: list  # list[EncryptionKeyId]
+
+    def to_json(self):
+        return {"id": self.id.to_json(), "keys": [k.to_json() for k in self.keys]}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=AgentId.from_json(obj["id"]),
+            keys=[EncryptionKeyId.from_json(k) for k in obj["keys"]],
+        )
+
+
+@dataclass
+class Committee:
+    """Committee elected for an aggregation (resources.rs:83-88)."""
+
+    aggregation: AggregationId
+    clerks_and_keys: list  # list[tuple[AgentId, EncryptionKeyId]]
+
+    def to_json(self):
+        return {
+            "aggregation": self.aggregation.to_json(),
+            "clerks_and_keys": [
+                [a.to_json(), k.to_json()] for (a, k) in self.clerks_and_keys
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+            clerks_and_keys=[
+                (AgentId.from_json(a), EncryptionKeyId.from_json(k))
+                for (a, k) in obj["clerks_and_keys"]
+            ],
+        )
+
+
+@dataclass
+class Participation:
+    """A participant's input to an aggregation (resources.rs:92-108).
+
+    ``id`` is client-chosen so retries are idempotent (resources.rs:93-101).
+    """
+
+    id: ParticipationId
+    participant: AgentId
+    aggregation: AggregationId
+    recipient_encryption: Optional[Encryption]
+    clerk_encryptions: list  # list[tuple[AgentId, Encryption]]
+
+    def to_json(self):
+        return {
+            "id": self.id.to_json(),
+            "participant": self.participant.to_json(),
+            "aggregation": self.aggregation.to_json(),
+            "recipient_encryption": _opt(self.recipient_encryption, lambda e: e.to_json()),
+            "clerk_encryptions": [
+                [a.to_json(), e.to_json()] for (a, e) in self.clerk_encryptions
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=ParticipationId.from_json(obj["id"]),
+            participant=AgentId.from_json(obj["participant"]),
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+            recipient_encryption=_opt(obj.get("recipient_encryption"), Encryption.from_json),
+            clerk_encryptions=[
+                (AgentId.from_json(a), Encryption.from_json(e))
+                for (a, e) in obj["clerk_encryptions"]
+            ],
+        )
+
+
+@dataclass
+class Snapshot:
+    """A consistent cut over the participation stream (resources.rs:116-121)."""
+
+    id: SnapshotId
+    aggregation: AggregationId
+
+    def to_json(self):
+        return {"id": self.id.to_json(), "aggregation": self.aggregation.to_json()}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=SnapshotId.from_json(obj["id"]),
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+        )
+
+
+@dataclass
+class ClerkingJob:
+    """Partial aggregation job for one clerk (resources.rs:128-139)."""
+
+    id: ClerkingJobId
+    clerk: AgentId
+    aggregation: AggregationId
+    snapshot: SnapshotId
+    encryptions: list  # list[Encryption], one per participant
+
+    def to_json(self):
+        return {
+            "id": self.id.to_json(),
+            "clerk": self.clerk.to_json(),
+            "aggregation": self.aggregation.to_json(),
+            "snapshot": self.snapshot.to_json(),
+            "encryptions": [e.to_json() for e in self.encryptions],
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=ClerkingJobId.from_json(obj["id"]),
+            clerk=AgentId.from_json(obj["clerk"]),
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+            snapshot=SnapshotId.from_json(obj["snapshot"]),
+            encryptions=[Encryption.from_json(e) for e in obj["encryptions"]],
+        )
+
+
+@dataclass
+class ClerkingResult:
+    """Result of a clerking job (resources.rs:146-153)."""
+
+    job: ClerkingJobId
+    clerk: AgentId
+    encryption: Encryption
+
+    def to_json(self):
+        return {
+            "job": self.job.to_json(),
+            "clerk": self.clerk.to_json(),
+            "encryption": self.encryption.to_json(),
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            job=ClerkingJobId.from_json(obj["job"]),
+            clerk=AgentId.from_json(obj["clerk"]),
+            encryption=Encryption.from_json(obj["encryption"]),
+        )
+
+
+@dataclass
+class SnapshotStatus:
+    """Status of a snapshot (resources.rs:168-175)."""
+
+    id: SnapshotId
+    number_of_clerking_results: int
+    result_ready: bool
+
+    def to_json(self):
+        return {
+            "id": self.id.to_json(),
+            "number_of_clerking_results": self.number_of_clerking_results,
+            "result_ready": self.result_ready,
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            id=SnapshotId.from_json(obj["id"]),
+            number_of_clerking_results=int(obj["number_of_clerking_results"]),
+            result_ready=bool(obj["result_ready"]),
+        )
+
+
+@dataclass
+class AggregationStatus:
+    """Status of an aggregation (resources.rs:157-164)."""
+
+    aggregation: AggregationId
+    number_of_participations: int
+    snapshots: list  # list[SnapshotStatus]
+
+    def to_json(self):
+        return {
+            "aggregation": self.aggregation.to_json(),
+            "number_of_participations": self.number_of_participations,
+            "snapshots": [s.to_json() for s in self.snapshots],
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+            number_of_participations=int(obj["number_of_participations"]),
+            snapshots=[SnapshotStatus.from_json(s) for s in obj["snapshots"]],
+        )
+
+
+@dataclass
+class SnapshotResult:
+    """Result of a snapshot, ready for reconstruction (resources.rs:179-188)."""
+
+    snapshot: SnapshotId
+    number_of_participations: int
+    clerk_encryptions: list  # list[ClerkingResult]
+    recipient_encryptions: Optional[list]  # Optional[list[Encryption]]
+
+    def to_json(self):
+        return {
+            "snapshot": self.snapshot.to_json(),
+            "number_of_participations": self.number_of_participations,
+            "clerk_encryptions": [c.to_json() for c in self.clerk_encryptions],
+            "recipient_encryptions": _opt(
+                self.recipient_encryptions, lambda es: [e.to_json() for e in es]
+            ),
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        recipient = obj.get("recipient_encryptions")
+        return cls(
+            snapshot=SnapshotId.from_json(obj["snapshot"]),
+            number_of_participations=int(obj["number_of_participations"]),
+            clerk_encryptions=[ClerkingResult.from_json(c) for c in obj["clerk_encryptions"]],
+            recipient_encryptions=None
+            if recipient is None
+            else [Encryption.from_json(e) for e in recipient],
+        )
+
+
+@dataclass
+class Pong:
+    """Return message of the ping call (methods.rs:6-10)."""
+
+    running: bool
+
+    def to_json(self):
+        return {"running": self.running}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(running=bool(obj["running"]))

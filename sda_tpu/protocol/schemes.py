@@ -1,0 +1,310 @@
+"""Cryptographic scheme descriptors and their derived properties.
+
+Wire parity with /root/reference/protocol/src/crypto.rs (serde externally
+tagged enums):
+- newtype variants: ``{"Sodium": "<base64>"}`` (Encryption, keys, Signature)
+- unit variants: ``"None"`` / ``"Sodium"`` (LinearMaskingScheme::None,
+  AdditiveEncryptionScheme::Sodium)
+- struct variants: ``{"Full": {"modulus": 433}}`` etc.
+
+Derived properties (input/output size, privacy/reconstruction thresholds)
+mirror crypto.rs:117-155; in particular the packed-Shamir dropout-tolerance
+formula ``reconstruction_threshold = privacy_threshold + secret_count``
+(crypto.rs:151).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .helpers import B32, B64, Binary
+
+
+def _tagged(tag, payload):
+    return {tag: payload}
+
+
+def _untag(obj, expected_tags):
+    """Decode an externally tagged enum value; returns (tag, payload)."""
+    if isinstance(obj, str):
+        if obj not in expected_tags:
+            raise ValueError(f"unknown enum variant {obj!r}, expected one of {expected_tags}")
+        return obj, None
+    if isinstance(obj, dict) and len(obj) == 1:
+        tag, payload = next(iter(obj.items()))
+        if tag not in expected_tags:
+            raise ValueError(f"unknown enum variant {tag!r}, expected one of {expected_tags}")
+        return tag, payload
+    raise ValueError(f"malformed enum value {obj!r}")
+
+
+class _SodiumNewtype:
+    """Base for single-variant ``Sodium(bytes)`` enums."""
+
+    INNER = None  # B32 / B64 / Binary
+    __slots__ = ("inner",)
+
+    def __init__(self, inner):
+        if isinstance(inner, (bytes, bytearray)):
+            inner = self.INNER(bytes(inner))
+        if not isinstance(inner, self.INNER):
+            raise TypeError(f"{type(self).__name__} expects {self.INNER.__name__}")
+        self.inner = inner
+
+    @property
+    def data(self) -> bytes:
+        return self.inner.data
+
+    def to_json(self):
+        return _tagged("Sodium", self.inner.to_json())
+
+    @classmethod
+    def from_json(cls, obj):
+        _, payload = _untag(obj, ("Sodium",))
+        return cls(cls.INNER.from_json(payload))
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other.inner == self.inner
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.inner))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.inner!r})"
+
+
+class Encryption(_SodiumNewtype):
+    """A ciphertext: sodium sealed box (Curve25519/XSalsa20/Poly1305)."""
+
+    INNER = Binary
+
+
+class EncryptionKey(_SodiumNewtype):
+    """Sodium box public key (32 bytes)."""
+
+    INNER = B32
+
+
+class Signature(_SodiumNewtype):
+    """Ed25519 detached signature (64 bytes)."""
+
+    INNER = B64
+
+
+class SigningKey(_SodiumNewtype):
+    """Ed25519 signing key (64 bytes: seed || public)."""
+
+    INNER = B64
+
+
+class VerificationKey(_SodiumNewtype):
+    """Ed25519 verification key (32 bytes)."""
+
+    INNER = B32
+
+
+# ---------------------------------------------------------------------------
+# Masking schemes
+# ---------------------------------------------------------------------------
+
+
+class LinearMaskingScheme:
+    """Masking scheme between recipient and committee (crypto.rs:43-74)."""
+
+    def has_mask(self) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_json(obj):
+        tag, payload = _untag(obj, ("None", "Full", "ChaCha"))
+        if tag == "None":
+            return NoMasking()
+        if tag == "Full":
+            return FullMasking(modulus=int(payload["modulus"]))
+        return ChaChaMasking(
+            modulus=int(payload["modulus"]),
+            dimension=int(payload["dimension"]),
+            seed_bitsize=int(payload["seed_bitsize"]),
+        )
+
+
+@dataclass(frozen=True)
+class NoMasking(LinearMaskingScheme):
+    """No masking: secrets are shared directly to the clerks."""
+
+    def has_mask(self) -> bool:
+        return False
+
+    def to_json(self):
+        return "None"
+
+
+@dataclass(frozen=True)
+class FullMasking(LinearMaskingScheme):
+    """Per-element uniform masking with fresh OS randomness."""
+
+    modulus: int
+
+    def has_mask(self) -> bool:
+        return True
+
+    def to_json(self):
+        return _tagged("Full", {"modulus": self.modulus})
+
+
+@dataclass(frozen=True)
+class ChaChaMasking(LinearMaskingScheme):
+    """Seed-compressed masking: upload a small seed, expand via ChaCha20.
+
+    Trades upload/download size for expansion compute on both sides
+    (crypto.rs:53-62).
+    """
+
+    modulus: int
+    dimension: int
+    seed_bitsize: int
+
+    def has_mask(self) -> bool:
+        return True
+
+    def to_json(self):
+        return _tagged(
+            "ChaCha",
+            {
+                "modulus": self.modulus,
+                "dimension": self.dimension,
+                "seed_bitsize": self.seed_bitsize,
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# Secret sharing schemes
+# ---------------------------------------------------------------------------
+
+
+class LinearSecretSharingScheme:
+    """Sharing scheme across the clerk committee (crypto.rs:79-155).
+
+    Derived properties are plain attributes/properties: ``input_size``
+    (secrets per batch), ``output_size`` (shares produced = committee size),
+    ``privacy_threshold`` (max colluding clerks tolerated), and
+    ``reconstruction_threshold`` (min clerk results needed).
+    """
+
+    @staticmethod
+    def from_json(obj):
+        tag, payload = _untag(obj, ("Additive", "PackedShamir"))
+        if tag == "Additive":
+            return AdditiveSharing(
+                share_count=int(payload["share_count"]), modulus=int(payload["modulus"])
+            )
+        return PackedShamirSharing(
+            secret_count=int(payload["secret_count"]),
+            share_count=int(payload["share_count"]),
+            privacy_threshold=int(payload["privacy_threshold"]),
+            prime_modulus=int(payload["prime_modulus"]),
+            omega_secrets=int(payload["omega_secrets"]),
+            omega_shares=int(payload["omega_shares"]),
+        )
+
+
+@dataclass(frozen=True)
+class AdditiveSharing(LinearSecretSharingScheme):
+    """n-of-n additive sharing in Z_modulus."""
+
+    share_count: int
+    modulus: int
+
+    @property
+    def input_size(self) -> int:
+        return 1
+
+    @property
+    def output_size(self) -> int:
+        return self.share_count
+
+    @property
+    def privacy_threshold(self) -> int:
+        return self.share_count - 1
+
+    @property
+    def reconstruction_threshold(self) -> int:
+        return self.share_count
+
+    def to_json(self):
+        return _tagged(
+            "Additive", {"share_count": self.share_count, "modulus": self.modulus}
+        )
+
+
+@dataclass(frozen=True)
+class PackedShamirSharing(LinearSecretSharingScheme):
+    """Packed Shamir over F_p: one degree-(t+k) polynomial hides k secrets.
+
+    Valid parameter sets satisfy ``order(omega_secrets) ==
+    secret_count + privacy_threshold + 1`` (a power of 2) and
+    ``order(omega_shares) == share_count + 1`` (a power of 3), with
+    ``p = 1 (mod 2^a * 3^b)``; see the verified p=433 test vector in
+    /root/reference/integration-tests/tests/full_loop.rs:56-64.
+    """
+
+    secret_count: int
+    share_count: int
+    privacy_threshold: int
+    prime_modulus: int
+    omega_secrets: int
+    omega_shares: int
+
+    @property
+    def input_size(self) -> int:
+        return self.secret_count
+
+    @property
+    def output_size(self) -> int:
+        return self.share_count
+
+    @property
+    def reconstruction_threshold(self) -> int:
+        return self.privacy_threshold + self.secret_count
+
+    def to_json(self):
+        return _tagged(
+            "PackedShamir",
+            {
+                "secret_count": self.secret_count,
+                "share_count": self.share_count,
+                "privacy_threshold": self.privacy_threshold,
+                "prime_modulus": self.prime_modulus,
+                "omega_secrets": self.omega_secrets,
+                "omega_shares": self.omega_shares,
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# Additive encryption schemes
+# ---------------------------------------------------------------------------
+
+
+class AdditiveEncryptionScheme:
+    """Transport encryption scheme for shares/masks (crypto.rs:159-188)."""
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_json(obj):
+        tag, _ = _untag(obj, ("Sodium",))
+        return SodiumEncryptionScheme()
+
+
+@dataclass(frozen=True)
+class SodiumEncryptionScheme(AdditiveEncryptionScheme):
+    """Sodium sealed-box transport encryption."""
+
+    def batch_size(self) -> int:
+        return 1
+
+    def to_json(self):
+        return "Sodium"

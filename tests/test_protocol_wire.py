@@ -1,0 +1,216 @@
+"""Wire-format parity tests for the protocol layer.
+
+Golden JSON fixtures follow the reference's serde encoding:
+- uuid ids as hyphenated strings (protocol/src/helpers.rs:19-86)
+- fixed byte arrays / Binary as padded standard base64 (byte_arrays.rs:3-99)
+- enums externally tagged; unit variants as bare strings (crypto.rs)
+- canonical signing bytes = compact JSON in declaration order
+  (helpers.rs:130-142)
+"""
+
+import json
+
+from sda_tpu.protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    AdditiveSharing,
+    B32,
+    B64,
+    Binary,
+    ChaChaMasking,
+    ClerkingJob,
+    ClerkingJobId,
+    Committee,
+    Encryption,
+    EncryptionKey,
+    EncryptionKeyId,
+    FullMasking,
+    Labelled,
+    LinearMaskingScheme,
+    LinearSecretSharingScheme,
+    NoMasking,
+    PackedShamirSharing,
+    Participation,
+    ParticipationId,
+    Signature,
+    Signed,
+    Snapshot,
+    SnapshotId,
+    SodiumEncryptionScheme,
+    VerificationKey,
+    VerificationKeyId,
+    canonical_bytes,
+    signed_encryption_key_from_json,
+)
+
+
+def roundtrip(obj, from_json):
+    encoded = json.dumps(obj.to_json())
+    decoded = from_json(json.loads(encoded))
+    assert decoded == obj
+    return json.loads(encoded)
+
+
+def test_ids_wire_format():
+    a = AgentId("ad3142d8-9a83-4f40-a64a-a8c90b701bde")
+    assert a.to_json() == "ad3142d8-9a83-4f40-a64a-a8c90b701bde"
+    assert AgentId.from_json(a.to_json()) == a
+    assert a != AggregationId("ad3142d8-9a83-4f40-a64a-a8c90b701bde")
+
+
+def test_byte_arrays_base64():
+    b = B32(bytes(range(32)))
+    s = b.to_json()
+    assert s == "AAECAwQFBgcICQoLDA0ODxAREhMUFRYXGBkaGxwdHh8="
+    assert B32.from_json(s) == b
+    assert B32().to_json() == "A" * 43 + "="  # all-zero default
+
+
+def test_scheme_enum_tagging():
+    assert NoMasking().to_json() == "None"
+    assert FullMasking(modulus=433).to_json() == {"Full": {"modulus": 433}}
+    assert ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128).to_json() == {
+        "ChaCha": {"modulus": 433, "dimension": 4, "seed_bitsize": 128}
+    }
+    assert SodiumEncryptionScheme().to_json() == "Sodium"
+    assert AdditiveSharing(share_count=3, modulus=433).to_json() == {
+        "Additive": {"share_count": 3, "modulus": 433}
+    }
+    packed = PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=433,
+        omega_secrets=354,
+        omega_shares=150,
+    )
+    assert packed.to_json() == {
+        "PackedShamir": {
+            "secret_count": 3,
+            "share_count": 8,
+            "privacy_threshold": 4,
+            "prime_modulus": 433,
+            "omega_secrets": 354,
+            "omega_shares": 150,
+        }
+    }
+    for scheme in (NoMasking(), FullMasking(433), ChaChaMasking(433, 4, 128)):
+        assert LinearMaskingScheme.from_json(scheme.to_json()) == scheme
+    for scheme in (AdditiveSharing(3, 433), packed):
+        assert LinearSecretSharingScheme.from_json(scheme.to_json()) == scheme
+
+
+def test_scheme_derived_properties():
+    # crypto.rs:117-155
+    add = AdditiveSharing(share_count=3, modulus=433)
+    assert add.input_size == 1
+    assert add.output_size == 3
+    assert add.privacy_threshold == 2
+    assert add.reconstruction_threshold == 3
+
+    packed = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+    assert packed.input_size == 3
+    assert packed.output_size == 8
+    assert packed.privacy_threshold == 4
+    # dropout tolerance: 8 - 7 = 1 clerk may fail (crypto.rs:151)
+    assert packed.reconstruction_threshold == 7
+
+    assert not NoMasking().has_mask()
+    assert FullMasking(433).has_mask()
+    assert ChaChaMasking(433, 4, 128).has_mask()
+
+
+def test_encryption_newtype_tagging():
+    e = Encryption(Binary(b"\x01\x02"))
+    assert e.to_json() == {"Sodium": "AQI="}
+    assert Encryption.from_json(e.to_json()) == e
+
+
+def test_canonical_signing_bytes():
+    # The canonical form of a labelled encryption key pins field order id,body
+    # and compact separators — signature compatibility depends on this.
+    key = Labelled(
+        EncryptionKeyId("00000000-0000-0000-0000-000000000001"),
+        EncryptionKey(B32(bytes(32))),
+    )
+    expected = (
+        b'{"id":"00000000-0000-0000-0000-000000000001",'
+        b'"body":{"Sodium":"' + b"A" * 43 + b'="}}'
+    )
+    assert canonical_bytes(key) == expected
+
+
+def test_agent_and_signed_key_roundtrip():
+    agent = Agent(
+        id=AgentId.random(),
+        verification_key=Labelled(VerificationKeyId.random(), VerificationKey(B32(bytes(32)))),
+    )
+    obj = roundtrip(agent, Agent.from_json)
+    assert set(obj.keys()) == {"id", "verification_key"}
+
+    signed = Signed(
+        signature=Signature(B64(bytes(64))),
+        signer=agent.id,
+        body=Labelled(EncryptionKeyId.random(), EncryptionKey(B32(bytes(32)))),
+    )
+    encoded = signed.to_json()
+    assert list(encoded.keys()) == ["signature", "signer", "body"]
+    assert signed_encryption_key_from_json(encoded) == signed
+
+
+def test_aggregation_roundtrip():
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="foo",
+        vector_dimension=4,
+        modulus=433,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    obj = roundtrip(agg, Aggregation.from_json)
+    assert list(obj.keys()) == [
+        "id",
+        "title",
+        "vector_dimension",
+        "modulus",
+        "recipient",
+        "recipient_key",
+        "masking_scheme",
+        "committee_sharing_scheme",
+        "recipient_encryption_scheme",
+        "committee_encryption_scheme",
+    ]
+
+
+def test_participation_and_committee_roundtrip():
+    agg_id = AggregationId.random()
+    clerks = [(AgentId.random(), EncryptionKeyId.random()) for _ in range(3)]
+    committee = Committee(aggregation=agg_id, clerks_and_keys=clerks)
+    obj = roundtrip(committee, Committee.from_json)
+    assert obj["clerks_and_keys"][0] == [str(clerks[0][0]), str(clerks[0][1])]
+
+    part = Participation(
+        id=ParticipationId.random(),
+        participant=AgentId.random(),
+        aggregation=agg_id,
+        recipient_encryption=None,
+        clerk_encryptions=[(c, Encryption(Binary(bytes([i])))) for i, (c, _) in enumerate(clerks)],
+    )
+    obj = roundtrip(part, Participation.from_json)
+    assert obj["recipient_encryption"] is None
+
+    job = ClerkingJob(
+        id=ClerkingJobId.random(),
+        clerk=clerks[0][0],
+        aggregation=agg_id,
+        snapshot=SnapshotId.random(),
+        encryptions=[Encryption(Binary(b"x"))],
+    )
+    roundtrip(job, ClerkingJob.from_json)
+    roundtrip(Snapshot(id=SnapshotId.random(), aggregation=agg_id), Snapshot.from_json)
