@@ -1,0 +1,48 @@
+"""sda_tpu.ops — the mod-p math plane.
+
+Pure-function kernels with two coordinated backends:
+- **numpy** (host): exact reference semantics, used by per-agent client code.
+- **jax/jnp** (device): vmapped/sharded batch kernels for the TPU
+  aggregation fabric.
+
+Both implement *Rust signed-remainder semantics* (`%` truncates toward zero,
+keeping the dividend's sign) so values match the reference implementation's
+in-flight representatives, not just its residue classes; see
+SURVEY.md §4 and /root/reference/client/src/receive.rs:14-20 (``positive()``).
+
+JAX is imported lazily — protocol/client-only use never pays for it.
+"""
+
+from .modular import (
+    mod_add,
+    mod_inverse,
+    mod_mul,
+    mod_pow,
+    modmatmul_np,
+    positive,
+    rust_rem,
+    rust_rem_np,
+)
+from .params import (
+    element_order,
+    find_packed_parameters,
+    is_prime,
+    validate_packed_parameters,
+)
+from .rng import uniform_mod_host
+
+__all__ = [
+    "rust_rem",
+    "rust_rem_np",
+    "positive",
+    "mod_add",
+    "mod_mul",
+    "mod_pow",
+    "mod_inverse",
+    "modmatmul_np",
+    "uniform_mod_host",
+    "is_prime",
+    "element_order",
+    "find_packed_parameters",
+    "validate_packed_parameters",
+]

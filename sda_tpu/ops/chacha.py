@@ -1,0 +1,91 @@
+"""Deterministic ChaCha20 keystream expansion for seed-compressed masking.
+
+The ChaCha masking scheme uploads only a small seed; participant (mask) and
+recipient (re-expansion) must expand it to a dim-length mask *bit-identically*
+or unmasking silently corrupts the result (SURVEY.md hard part #4; reference:
+client/src/crypto/masking/chacha.rs).
+
+Expansion spec (self-contained; this framework is both producer and consumer):
+- Key: the seed's u32 words zero-padded to 8 words (256-bit key), as the
+  reference pads short seeds (rand 0.3 ChaChaRng::from_seed semantics).
+- Stream: classic djb ChaCha20 with 64-bit block counter in words 12-13 and
+  zero nonce, starting at counter 0; output words consumed in order.
+- Draws: consecutive word pairs form u64s as ``(w[2i] << 32) | w[2i+1]``;
+  pairs >= zone are rejected (zone = 2**64 - 2**64 % m) and skipped; accepted
+  pairs reduce mod m. Unbiased, and deterministic given the seed.
+
+Implemented with vectorized numpy uint32 (wrapping arithmetic); block-level
+parallel so a 100K-dim expansion is ~3K independent blocks — the same
+formulation a Pallas port would use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_CONSTANTS = np.array([0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32)
+
+_QUARTER_ROUNDS = [
+    # column rounds
+    (0, 4, 8, 12),
+    (1, 5, 9, 13),
+    (2, 6, 10, 14),
+    (3, 7, 11, 15),
+    # diagonal rounds
+    (0, 5, 10, 15),
+    (1, 6, 11, 12),
+    (2, 7, 8, 13),
+    (3, 4, 9, 14),
+]
+
+
+def _rotl(x: np.ndarray, r: int) -> np.ndarray:
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def chacha_blocks(key_words: np.ndarray, first_counter: int, n_blocks: int) -> np.ndarray:
+    """n_blocks ChaCha20 blocks -> (n_blocks, 16) uint32 keystream words."""
+    key = np.zeros(8, dtype=np.uint32)
+    key[: len(key_words)] = np.asarray(key_words, dtype=np.uint32)
+    counters = np.arange(first_counter, first_counter + n_blocks, dtype=np.uint64)
+    state = np.zeros((n_blocks, 16), dtype=np.uint32)
+    state[:, 0:4] = _CONSTANTS
+    state[:, 4:12] = key
+    state[:, 12] = (counters & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    state[:, 13] = (counters >> np.uint64(32)).astype(np.uint32)
+    # words 14-15: zero nonce
+
+    x = state.copy()
+    with np.errstate(over="ignore"):
+        for _ in range(10):  # 20 rounds = 10 double rounds
+            for (a, b, c, d) in _QUARTER_ROUNDS:
+                x[:, a] += x[:, b]
+                x[:, d] = _rotl(x[:, d] ^ x[:, a], 16)
+                x[:, c] += x[:, d]
+                x[:, b] = _rotl(x[:, b] ^ x[:, c], 12)
+                x[:, a] += x[:, b]
+                x[:, d] = _rotl(x[:, d] ^ x[:, a], 8)
+                x[:, c] += x[:, d]
+                x[:, b] = _rotl(x[:, b] ^ x[:, c], 7)
+        x += state
+    return x
+
+
+def expand_seed(seed_words, dim: int, modulus: int) -> np.ndarray:
+    """Expand seed u32 words to a dim-length int64 mask in [0, modulus)."""
+    if modulus <= 0:
+        raise ValueError("modulus must be positive")
+    rejection = (1 << 64) % modulus != 0
+    zone = (1 << 64) - ((1 << 64) % modulus)
+    out = np.empty(0, dtype=np.int64)
+    counter = 0
+    while len(out) < dim:
+        need_pairs = (dim - len(out)) + 8  # slack for rare rejections
+        n_blocks = (need_pairs * 2 + 15) // 16
+        words = chacha_blocks(seed_words, counter, n_blocks).reshape(-1)
+        counter += n_blocks
+        u64 = (words[0::2].astype(np.uint64) << np.uint64(32)) | words[1::2].astype(np.uint64)
+        if rejection:
+            u64 = u64[u64 < np.uint64(zone)]
+        out = np.concatenate([out, (u64 % np.uint64(modulus)).astype(np.int64)])
+    return out[:dim]

@@ -1,0 +1,19 @@
+"""JAX configuration shared by all device-path modules.
+
+The math plane works in int64 (moduli up to 61 bits); JAX defaults to 32-bit,
+so every module that touches jax calls ``ensure_x64()`` before tracing.
+"""
+
+from __future__ import annotations
+
+_done = False
+
+
+def ensure_x64() -> None:
+    global _done
+    if _done:
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _done = True

@@ -1,0 +1,42 @@
+"""Lagrange interpolation at arbitrary index subsets over F_p.
+
+This is the dropout-recovery kernel: packed-Shamir reconstruction must work
+from *any* ``reconstruction_threshold + 1`` surviving clerk shares, carried
+with their explicit committee indices (reference:
+client/src/receive.rs:127-138; tss reconstruct takes ``&[usize]`` indices).
+
+TPU-first shape: for a given surviving subset, precompute the (targets x
+shares) interpolation matrix exactly on host, then reconstruction over all
+dimension-batches is one batched mod-p matmul. The subset changes rarely
+(when clerks drop), the batch axis is huge — the right side of the
+compute/precompute trade.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def lagrange_matrix(xs, targets, p: int) -> np.ndarray:
+    """M[t, j] such that poly(targets[t]) = sum_j M[t, j] * values[j] mod p.
+
+    ``xs`` are the distinct interpolation points, ``targets`` the evaluation
+    points. Exact integer construction, canonical representatives.
+    """
+    xs = [x % p for x in xs]
+    if len(set(xs)) != len(xs):
+        raise ValueError("interpolation points must be distinct")
+    rows = []
+    for t in targets:
+        t = t % p
+        row = []
+        for j, xj in enumerate(xs):
+            num, den = 1, 1
+            for m, xm in enumerate(xs):
+                if m == j:
+                    continue
+                num = num * ((t - xm) % p) % p
+                den = den * ((xj - xm) % p) % p
+            row.append(num * pow(den, p - 2, p) % p)
+        rows.append(row)
+    return np.array(rows, dtype=np.int64)

@@ -1,0 +1,139 @@
+"""Modular arithmetic with Rust signed-remainder semantics.
+
+The reference does all group math with Rust's ``%``, which truncates toward
+zero: ``-7 % 5 == -2`` (see e.g. additive share generation,
+/root/reference/client/src/crypto/sharing/additive.rs:47, and the final sign
+fix-up ``positive()`` at client/src/receive.rs:14-20). Python's ``%`` floors
+instead, so every hot-path reduction here goes through ``rust_rem``:
+``numpy.fmod`` on host, ``lax.rem`` on device — both truncate.
+
+Values are kept in ``(-m, m)`` throughout, exactly like the reference's
+in-flight share values; ``positive()`` lifts to ``[0, m)`` at the very end.
+
+Products for moduli < 2**31 fit int64; the int64 path is the correctness
+baseline on all backends. (TPUs emulate int64 with 32-bit lanes — the perf
+plane replaces these with limb-decomposed int32/MXU kernels, see
+``sda_tpu/parallel``.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def rust_rem_np(x, m):
+    """Truncated remainder (Rust ``%``) for numpy arrays / scalars."""
+    return np.fmod(x, m)
+
+
+def rust_rem_int(x: int, m: int) -> int:
+    """Truncated remainder for python ints."""
+    r = abs(x) % m
+    return -r if x < 0 else r
+
+
+def positive(x, m):
+    """Lift representatives from ``(-m, m)`` to canonical ``[0, m)``.
+
+    Mirrors ``RecipientOutput::positive`` (client/src/receive.rs:14-20).
+    Works for numpy arrays and python ints.
+    """
+    if isinstance(x, (int, np.integer)):
+        return x + m if x < 0 else x
+    x = np.asarray(x)
+    return np.where(x < 0, x + m, x)
+
+
+def mod_add(a, b, m):
+    """(a + b) with one truncated reduction; inputs in (-m, m)."""
+    return rust_rem_np(np.asarray(a, dtype=np.int64) + np.asarray(b, dtype=np.int64), m)
+
+
+def mod_mul(a, b, m):
+    """(a * b) % m in int64; valid for m < 2**31 (products < 2**62)."""
+    return rust_rem_np(np.asarray(a, dtype=np.int64) * np.asarray(b, dtype=np.int64), m)
+
+
+def mod_pow(base: int, exp: int, m: int) -> int:
+    """Scalar modular exponentiation (canonical representative)."""
+    return pow(base % m, exp, m)
+
+
+def mod_inverse(a: int, m: int) -> int:
+    """Inverse of a mod prime m (canonical representative)."""
+    a = a % m
+    if a == 0:
+        raise ZeroDivisionError("no inverse of 0")
+    return pow(a, m - 2, m)
+
+
+def modmatmul_np(A: np.ndarray, B: np.ndarray, m: int) -> np.ndarray:
+    """Exact (A @ B) mod m over int64 for m < 2**31.
+
+    Products are reduced before the K-sum so the int64 accumulator cannot
+    overflow for any K < 2**32: each reduced product lies in (-m, m).
+    Result keeps truncated-remainder representatives in (-m, m).
+    """
+    A = np.asarray(A, dtype=np.int64)
+    B = np.asarray(B, dtype=np.int64)
+    prods = rust_rem_np(A[..., :, None] * B[None, ...], m)  # (..., K, N)
+    return rust_rem_np(prods.sum(axis=-2), m)
+
+
+# ---------------------------------------------------------------------------
+# JAX backend (lazy import)
+# ---------------------------------------------------------------------------
+
+
+def rust_rem(x, m):
+    """Truncated remainder (Rust ``%``) for jax arrays; jittable."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    return lax.rem(x, jnp.asarray(m, dtype=x.dtype))
+
+
+def positive_jnp(x, m):
+    import jax.numpy as jnp
+
+    return jnp.where(x < 0, x + m, x)
+
+
+def mod_sum_jnp(x, m, axis):
+    """Sum along ``axis`` then one truncated reduction; int64 accumulate.
+
+    The clerk-combine hot loop (reference: elementwise ``+= ; %=`` per
+    participant, client/src/crypto/sharing/combiner.rs:16-30) becomes a
+    single HBM-resident reduction. Safe for < 2**32 summands with |x| < m
+    < 2**31.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    s = jnp.sum(x.astype(jnp.int64), axis=axis)
+    return lax.rem(s, jnp.asarray(m, dtype=s.dtype))
+
+
+def modmatmul_jnp(A, B, m):
+    """Exact (A @ B) mod m on device; per-product reduction then int64 sum.
+
+    Correctness-first path (int64 emulated on TPU). The perf plane lowers
+    this to int8-limb MXU matmuls.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    A = A.astype(jnp.int64)
+    B = B.astype(jnp.int64)
+    mm = jnp.asarray(m, dtype=jnp.int64)
+    prods = lax.rem(A[..., :, None] * B[None, ...], mm)
+    return lax.rem(jnp.sum(prods, axis=-2), mm)

@@ -1,0 +1,53 @@
+"""Number-theoretic transforms over F_p.
+
+The packed-Shamir domains are tiny-but-many: a radix-2 domain of size
+``secret_count + privacy_threshold + 1`` and a radix-3 domain of size
+``share_count + 1`` (SURVEY.md §2.2). The TPU-first shape is therefore a
+*matrix* formulation — precompute the (inverse) DFT matrices once per scheme
+on host with exact integer arithmetic, then run the transform as a batched
+mod-p matmul over the (batches, domain) axis: ``vmap``-free, MXU-friendly,
+and trivially shardable along the batch axis.
+
+A recursive radix NTT only wins for domains ≳ 10**3; the scheme algebra keeps
+domains small by construction (dimension is *batched*, not transformed), so
+the matmul path is the primary one, not a fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .modular import modmatmul_np
+
+
+def dft_matrix(omega: int, n: int, p: int) -> np.ndarray:
+    """V[i, j] = omega^(i*j) mod p, exact, canonical representatives."""
+    rows = []
+    for i in range(n):
+        w = pow(omega, i, p)
+        row, acc = [], 1
+        for _ in range(n):
+            row.append(acc)
+            acc = acc * w % p
+        rows.append(row)
+    return np.array(rows, dtype=np.int64)
+
+
+def inverse_dft_matrix(omega: int, n: int, p: int) -> np.ndarray:
+    """V^-1[i, j] = n^-1 * omega^(-i*j) mod p."""
+    n_inv = pow(n, p - 2, p)
+    omega_inv = pow(omega, p - 2, p)
+    V = dft_matrix(omega_inv, n, p)
+    return (V * n_inv) % p
+
+
+def ntt(values: np.ndarray, omega: int, p: int) -> np.ndarray:
+    """Forward transform of the trailing axis: values @ V^T mod p."""
+    n = values.shape[-1]
+    return modmatmul_np(values, dft_matrix(omega, n, p).T, p)
+
+
+def intt(values: np.ndarray, omega: int, p: int) -> np.ndarray:
+    """Inverse transform of the trailing axis."""
+    n = values.shape[-1]
+    return modmatmul_np(values, inverse_dft_matrix(omega, n, p).T, p)

@@ -1,0 +1,161 @@
+"""Packed-Shamir parameter generation and validation.
+
+The reference carries ``prime_modulus / omega_secrets / omega_shares`` inside
+the scheme descriptor (protocol/src/crypto.rs:99-112) and leaves generating
+them to an offline tool (the tss crate does the same). This module is that
+tool: valid parameter sets satisfy
+
+- ``order(omega_secrets) == secret_count + privacy_threshold + 1 == 2**a``
+- ``order(omega_shares) == share_count + 1 == 3**b``
+- ``p`` prime with ``2**a * 3**b | p - 1``
+
+verified numerically against the reference test vector ``p=433,
+omega_secrets=354 (order 8), omega_shares=150 (order 9)``
+(/root/reference/integration-tests/tests/full_loop.rs:56-64, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+
+_SMALL_PRIMES = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37]
+
+
+def is_prime(n: int) -> bool:
+    """Deterministic Miller-Rabin for n < 3.3e24 (covers 64-bit)."""
+    if n < 2:
+        return False
+    for p in _SMALL_PRIMES:
+        if n % p == 0:
+            return n == p
+    d = n - 1
+    r = 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for a in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _factorize(n: int) -> dict:
+    """Prime factorization (trial division + Pollard rho); fine for 64-bit."""
+    factors: dict = {}
+
+    def add(p):
+        factors[p] = factors.get(p, 0) + 1
+
+    def rho(n):
+        if n % 2 == 0:
+            return 2
+        while True:
+            x = random.randrange(2, n)
+            y, c, d = x, random.randrange(1, n), 1
+            while d == 1:
+                x = (x * x + c) % n
+                y = (y * y + c) % n
+                y = (y * y + c) % n
+                d = math.gcd(abs(x - y), n)
+            if d != n:
+                return d
+
+    stack = [n]
+    while stack:
+        m = stack.pop()
+        if m == 1:
+            continue
+        if is_prime(m):
+            add(m)
+            continue
+        for p in _SMALL_PRIMES:
+            if m % p == 0:
+                add(p)
+                stack.append(m // p)
+                break
+        else:
+            d = rho(m)
+            stack.extend([d, m // d])
+    return factors
+
+
+def element_order(x: int, p: int) -> int:
+    """Multiplicative order of x in F_p*."""
+    x = x % p
+    if x == 0:
+        raise ValueError("0 has no multiplicative order")
+    order = p - 1
+    for q in _factorize(p - 1):
+        while order % q == 0 and pow(x, order // q, p) == 1:
+            order //= q
+    return order
+
+
+def _root_of_unity(p: int, n: int, rng: random.Random) -> int:
+    """Find an element of exact order n in F_p* (requires n | p-1)."""
+    if (p - 1) % n != 0:
+        raise ValueError(f"{n} does not divide p-1")
+    n_factors = _factorize(n)
+    while True:
+        g = rng.randrange(2, p)
+        omega = pow(g, (p - 1) // n, p)
+        if omega == 1:
+            continue
+        if all(pow(omega, n // q, p) != 1 for q in n_factors):
+            return omega
+
+
+def validate_packed_parameters(scheme) -> None:
+    """Raise ValueError unless a PackedShamirSharing scheme is well-formed."""
+    m2 = scheme.secret_count + scheme.privacy_threshold + 1
+    m3 = scheme.share_count + 1
+    p = scheme.prime_modulus
+    if m2 & (m2 - 1) != 0:
+        raise ValueError(f"secret_count+privacy_threshold+1={m2} must be a power of 2")
+    if 3 ** round(math.log(m3, 3)) != m3:
+        raise ValueError(f"share_count+1={m3} must be a power of 3")
+    if not is_prime(p):
+        raise ValueError(f"prime_modulus={p} is not prime")
+    if element_order(scheme.omega_secrets, p) != m2:
+        raise ValueError(f"omega_secrets must have order {m2}")
+    if element_order(scheme.omega_shares, p) != m3:
+        raise ValueError(f"omega_shares must have order {m3}")
+    if scheme.share_count < scheme.reconstruction_threshold:
+        raise ValueError("share_count below reconstruction threshold")
+
+
+def find_packed_parameters(
+    secret_count: int,
+    privacy_threshold: int,
+    share_count: int,
+    min_modulus_bits: int = 24,
+    seed: int | None = None,
+):
+    """Generate ``(prime_modulus, omega_secrets, omega_shares)``.
+
+    Searches the smallest prime ``p >= 2**min_modulus_bits`` with
+    ``m2*m3 | p-1``, then samples roots of unity of exact orders m2, m3.
+    """
+    m2 = secret_count + privacy_threshold + 1
+    m3 = share_count + 1
+    if m2 & (m2 - 1) != 0:
+        raise ValueError(f"secret_count+privacy_threshold+1={m2} must be a power of 2")
+    b = round(math.log(m3, 3))
+    if 3**b != m3:
+        raise ValueError(f"share_count+1={m3} must be a power of 3")
+    step = m2 * m3
+    c = (2**min_modulus_bits) // step + 1
+    while not is_prime(c * step + 1):
+        c += 1
+    p = c * step + 1
+    rng = random.Random(seed)
+    return p, _root_of_unity(p, m2, rng), _root_of_unity(p, m3, rng)
