@@ -1,0 +1,122 @@
+"""File-based client store and keystore.
+
+Mirrors the reference's jfs-backed ``Filebased`` store (client-store/src/
+file.rs): one JSON file per object under a directory, plus alias indirection
+(``alias -> id -> object``, store.rs:11-40) used by the CLI to remember "the
+agent identity in this directory".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from ..protocol import B32, B64
+from ..protocol.schemes import EncryptionKey, SigningKey, VerificationKey, _untag
+
+
+@dataclass
+class DecryptionKey:
+    """Sodium box secret key (client/src/crypto/encryption/mod.rs:8-10)."""
+
+    inner: B32
+
+    def to_json(self):
+        return {"Sodium": self.inner.to_json()}
+
+    @classmethod
+    def from_json(cls, obj):
+        _, payload = _untag(obj, ("Sodium",))
+        return cls(B32.from_json(payload))
+
+    @property
+    def data(self) -> bytes:
+        return self.inner.data
+
+
+@dataclass
+class EncryptionKeypair:
+    ek: EncryptionKey
+    dk: DecryptionKey
+
+    def to_json(self):
+        return {"ek": self.ek.to_json(), "dk": self.dk.to_json()}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            ek=EncryptionKey.from_json(obj["ek"]), dk=DecryptionKey.from_json(obj["dk"])
+        )
+
+
+@dataclass
+class SignatureKeypair:
+    vk: VerificationKey
+    sk: SigningKey
+
+    def to_json(self):
+        return {"vk": self.vk.to_json(), "sk": self.sk.to_json()}
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            vk=VerificationKey.from_json(obj["vk"]), sk=SigningKey.from_json(obj["sk"])
+        )
+
+
+class Filebased:
+    """One JSON file per object; safe for ids and aliases used here."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        os.makedirs(self.path, exist_ok=True)
+
+    def _file(self, id: str) -> str:
+        if "/" in id or id.startswith("."):
+            raise ValueError(f"bad store id {id!r}")
+        return os.path.join(self.path, f"{id}.json")
+
+    def put(self, id: str, obj) -> None:
+        payload = obj.to_json() if hasattr(obj, "to_json") else obj
+        tmp = self._file(id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._file(id))
+
+    def get(self, id: str, from_json=None):
+        try:
+            with open(self._file(id)) as f:
+                payload = json.load(f)
+        except FileNotFoundError:
+            return None
+        return from_json(payload) if from_json else payload
+
+    # alias indirection (client-store/src/store.rs:11-40)
+
+    def put_aliased(self, alias: str, obj) -> None:
+        ident = str(obj.id)
+        self.put(ident, obj)
+        self.put(f"alias-{alias}", {"id": ident})
+
+    def get_aliased(self, alias: str, from_json=None):
+        pointer = self.get(f"alias-{alias}")
+        if pointer is None:
+            return None
+        return self.get(pointer["id"], from_json)
+
+
+class Keystore(Filebased):
+    """Keypair storage keyed by EncryptionKeyId / VerificationKeyId."""
+
+    def put_encryption_keypair(self, key_id, pair: EncryptionKeypair) -> None:
+        self.put(str(key_id), pair)
+
+    def get_encryption_keypair(self, key_id) -> EncryptionKeypair | None:
+        return self.get(str(key_id), EncryptionKeypair.from_json)
+
+    def put_signature_keypair(self, key_id, pair: SignatureKeypair) -> None:
+        self.put(str(key_id), pair)
+
+    def get_signature_keypair(self, key_id) -> SignatureKeypair | None:
+        return self.get(str(key_id), SignatureKeypair.from_json)

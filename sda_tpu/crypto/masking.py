@@ -1,0 +1,126 @@
+"""Masking schemes: None / Full / ChaCha.
+
+Semantics mirror /root/reference/client/src/crypto/masking/: the participant
+produces ``(recipient_mask, masked_secrets)``; the recipient later combines
+all participants' masks and subtracts. Vectors are numpy int64 throughout
+(the reference loops element-wise; here each op is one vectorized kernel).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.chacha import expand_seed
+from ..ops.modular import rust_rem_np
+from ..ops.rng import uniform_mod_host
+from ..protocol import ChaChaMasking, FullMasking, NoMasking
+
+
+class SecretMasker:
+    def mask(self, secrets: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """secrets -> (mask-for-recipient, masked-secrets-for-committee)."""
+        raise NotImplementedError
+
+
+class MaskCombiner:
+    def combine(self, masks: list) -> np.ndarray:
+        """Combine all participants' uploaded masks into one."""
+        raise NotImplementedError
+
+
+class SecretUnmasker:
+    def unmask(self, mask: np.ndarray, masked: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+class NoMasker(SecretMasker, MaskCombiner, SecretUnmasker):
+    """Zero masking: empty mask, secrets pass through (masking/none.rs)."""
+
+    def mask(self, secrets):
+        return np.empty(0, dtype=np.int64), np.asarray(secrets, dtype=np.int64).copy()
+
+    def combine(self, masks):
+        assert all(len(m) == 0 for m in masks)
+        return np.empty(0, dtype=np.int64)
+
+    def unmask(self, mask, masked):
+        assert len(mask) == 0
+        return np.asarray(masked, dtype=np.int64).copy()
+
+
+class FullMasker(SecretMasker, MaskCombiner, SecretUnmasker):
+    """Per-element uniform masks from OS entropy (masking/full.rs)."""
+
+    def __init__(self, modulus: int):
+        self.modulus = modulus
+
+    def mask(self, secrets):
+        secrets = np.asarray(secrets, dtype=np.int64)
+        masks = uniform_mod_host(secrets.shape, self.modulus)
+        masked = rust_rem_np(secrets + masks, self.modulus)
+        return masks, masked
+
+    def combine(self, masks):
+        if not masks:
+            return np.empty(0, dtype=np.int64)
+        total = np.sum(np.stack([np.asarray(m, dtype=np.int64) for m in masks]), axis=0)
+        return rust_rem_np(total, self.modulus)
+
+    def unmask(self, mask, masked):
+        return rust_rem_np(np.asarray(masked, np.int64) - np.asarray(mask, np.int64), self.modulus)
+
+
+class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
+    """Seed-compressed masks (masking/chacha.rs): upload only the seed.
+
+    The uploaded "mask" is the seed's u32 words as i64s (matching the
+    reference's wire shape, chacha.rs:48-52); both sides expand with the
+    deterministic keystream in ``sda_tpu.ops.chacha``.
+    """
+
+    def __init__(self, modulus: int, dimension: int, seed_bitsize: int):
+        self.modulus = modulus
+        self.dimension = dimension
+        self.seed_words = (seed_bitsize + 31) // 32
+
+    def mask(self, secrets):
+        secrets = np.asarray(secrets, dtype=np.int64)
+        if len(secrets) != self.dimension:
+            raise ValueError("dimension mismatch")
+        seed = uniform_mod_host((self.seed_words,), 1 << 32).astype(np.uint32)
+        mask = expand_seed(seed, self.dimension, self.modulus)
+        masked = rust_rem_np(secrets + mask, self.modulus)
+        return seed.astype(np.int64), masked
+
+    def combine(self, seeds):
+        result = np.zeros(self.dimension, dtype=np.int64)
+        for seed_i64 in seeds:
+            seed = np.asarray(seed_i64, dtype=np.int64).astype(np.uint32)
+            mask = expand_seed(seed, self.dimension, self.modulus)
+            result = rust_rem_np(result + mask, self.modulus)
+        return result
+
+    def unmask(self, mask, masked):
+        return rust_rem_np(np.asarray(masked, np.int64) - np.asarray(mask, np.int64), self.modulus)
+
+
+def new_secret_masker(scheme) -> SecretMasker:
+    return _dispatch(scheme)
+
+
+def new_mask_combiner(scheme) -> MaskCombiner:
+    return _dispatch(scheme)
+
+
+def new_secret_unmasker(scheme) -> SecretUnmasker:
+    return _dispatch(scheme)
+
+
+def _dispatch(scheme):
+    if isinstance(scheme, NoMasking):
+        return NoMasker()
+    if isinstance(scheme, FullMasking):
+        return FullMasker(scheme.modulus)
+    if isinstance(scheme, ChaChaMasking):
+        return ChaChaMasker(scheme.modulus, scheme.dimension, scheme.seed_bitsize)
+    raise TypeError(f"unknown masking scheme {scheme!r}")

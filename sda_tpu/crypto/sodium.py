@@ -1,0 +1,103 @@
+"""libsodium bindings via ctypes.
+
+The reference leans on sodiumoxide for sealed boxes (Curve25519/XSalsa20/
+Poly1305) and Ed25519 detached signatures (client/src/crypto/encryption/
+sodium.rs, signing/mod.rs). We bind the same primitives from the system
+libsodium, so ciphertexts and signatures are interoperable with any libsodium
+consumer. Batch throughput (thousands of seals per call) lives in
+``sda_tpu/native`` — this module is the always-available scalar path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+
+
+class SodiumError(Exception):
+    pass
+
+
+_lib = None
+
+
+def _sodium():
+    global _lib
+    if _lib is None:
+        name = ctypes.util.find_library("sodium") or "libsodium.so.23"
+        lib = ctypes.CDLL(name)
+        if lib.sodium_init() < 0:
+            raise SodiumError("sodium_init failed")
+        _lib = lib
+    return _lib
+
+
+BOX_PUBLICKEYBYTES = 32
+BOX_SECRETKEYBYTES = 32
+SEALBYTES = 48  # crypto_box_SEALBYTES = PUBLICKEYBYTES + MACBYTES
+SIGN_PUBLICKEYBYTES = 32
+SIGN_SECRETKEYBYTES = 64
+SIGN_BYTES = 64
+
+
+def box_keypair() -> tuple[bytes, bytes]:
+    """Generate a Curve25519 box keypair -> (public, secret)."""
+    lib = _sodium()
+    pk = ctypes.create_string_buffer(BOX_PUBLICKEYBYTES)
+    sk = ctypes.create_string_buffer(BOX_SECRETKEYBYTES)
+    if lib.crypto_box_keypair(pk, sk) != 0:
+        raise SodiumError("crypto_box_keypair failed")
+    return pk.raw, sk.raw
+
+
+def seal(message: bytes, public_key: bytes) -> bytes:
+    """Anonymous sealed box: ephemeral-key encrypt to ``public_key``."""
+    lib = _sodium()
+    out = ctypes.create_string_buffer(len(message) + SEALBYTES)
+    if lib.crypto_box_seal(out, message, ctypes.c_ulonglong(len(message)), public_key) != 0:
+        raise SodiumError("crypto_box_seal failed")
+    return out.raw
+
+
+def seal_open(ciphertext: bytes, public_key: bytes, secret_key: bytes) -> bytes:
+    """Open a sealed box; raises SodiumError on forgery/corruption."""
+    lib = _sodium()
+    if len(ciphertext) < SEALBYTES:
+        raise SodiumError("ciphertext too short")
+    out = ctypes.create_string_buffer(len(ciphertext) - SEALBYTES)
+    rc = lib.crypto_box_seal_open(
+        out, ciphertext, ctypes.c_ulonglong(len(ciphertext)), public_key, secret_key
+    )
+    if rc != 0:
+        raise SodiumError("sealed box open failed")
+    return out.raw
+
+
+def sign_keypair() -> tuple[bytes, bytes]:
+    """Generate an Ed25519 keypair -> (verify 32B, signing 64B)."""
+    lib = _sodium()
+    vk = ctypes.create_string_buffer(SIGN_PUBLICKEYBYTES)
+    sk = ctypes.create_string_buffer(SIGN_SECRETKEYBYTES)
+    if lib.crypto_sign_keypair(vk, sk) != 0:
+        raise SodiumError("crypto_sign_keypair failed")
+    return vk.raw, sk.raw
+
+
+def sign_detached(message: bytes, signing_key: bytes) -> bytes:
+    lib = _sodium()
+    sig = ctypes.create_string_buffer(SIGN_BYTES)
+    siglen = ctypes.c_ulonglong(0)
+    rc = lib.crypto_sign_detached(
+        sig, ctypes.byref(siglen), message, ctypes.c_ulonglong(len(message)), signing_key
+    )
+    if rc != 0:
+        raise SodiumError("crypto_sign_detached failed")
+    return sig.raw
+
+
+def verify_detached(signature: bytes, message: bytes, verify_key: bytes) -> bool:
+    lib = _sodium()
+    rc = lib.crypto_sign_verify_detached(
+        signature, message, ctypes.c_ulonglong(len(message)), verify_key
+    )
+    return rc == 0

@@ -1,0 +1,162 @@
+"""Crypto layer tests: varint parity, sodium roundtrips, schemes, signing."""
+
+import numpy as np
+import pytest
+
+from sda_tpu.crypto import CryptoModule, Keystore, encryption, masking, sharing, signing
+from sda_tpu.crypto import sodium, varint
+from sda_tpu.ops.modular import positive, rust_rem_np
+from sda_tpu.protocol import (
+    Agent,
+    AgentId,
+    AdditiveSharing,
+    ChaChaMasking,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+
+PACKED = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+
+
+def test_varint_known_encodings():
+    # zigzag: 0->0, -1->1, 1->2, -2->3 ; LEB128 little-endian 7-bit groups
+    assert varint.encode_i64(np.array([0], dtype=np.int64)) == b"\x00"
+    assert varint.encode_i64(np.array([-1], dtype=np.int64)) == b"\x01"
+    assert varint.encode_i64(np.array([1], dtype=np.int64)) == b"\x02"
+    assert varint.encode_i64(np.array([-2], dtype=np.int64)) == b"\x03"
+    assert varint.encode_i64(np.array([64], dtype=np.int64)) == b"\x80\x01"
+    got = varint.encode_i64(np.array([0, -1, 300], dtype=np.int64))
+    assert got == b"\x00\x01\xd8\x04"
+
+
+def test_varint_roundtrip_extremes():
+    vals = np.array(
+        [0, 1, -1, 2**62, -(2**62), 2**63 - 1, -(2**63), 433, -432],
+        dtype=np.int64,
+    )
+    buf = varint.encode_i64(vals)
+    np.testing.assert_array_equal(varint.decode_i64(buf), vals)
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-(2**63), 2**63 - 1, size=10000, dtype=np.int64)
+    np.testing.assert_array_equal(varint.decode_i64(varint.encode_i64(vals)), vals)
+
+
+def test_sodium_sealed_box_roundtrip():
+    pk, sk = sodium.box_keypair()
+    msg = b"attack at dawn" * 10
+    ct = sodium.seal(msg, pk)
+    assert len(ct) == len(msg) + sodium.SEALBYTES
+    assert sodium.seal_open(ct, pk, sk) == msg
+    with pytest.raises(sodium.SodiumError):
+        sodium.seal_open(ct[:-1] + bytes([ct[-1] ^ 1]), pk, sk)
+
+
+def test_sodium_sign_verify():
+    vk, sk = sodium.sign_keypair()
+    msg = b"canonical json bytes"
+    sig = sodium.sign_detached(msg, sk)
+    assert sodium.verify_detached(sig, msg, vk)
+    assert not sodium.verify_detached(sig, msg + b"!", vk)
+
+
+def test_encryptor_decryptor_roundtrip(tmp_path):
+    ks = Keystore(tmp_path)
+    module = CryptoModule(ks)
+    key_id = module.new_encryption_key()
+    pair = ks.get_encryption_keypair(key_id)
+    enc = encryption.new_share_encryptor(pair.ek, SodiumEncryptionScheme())
+    dec = module.new_share_decryptor(key_id, SodiumEncryptionScheme())
+    shares = np.array([1, -432, 0, 2**31], dtype=np.int64)
+    np.testing.assert_array_equal(dec.decrypt(enc.encrypt(shares)), shares)
+
+
+def test_sign_export_and_verify(tmp_path):
+    ks = Keystore(tmp_path)
+    module = CryptoModule(ks)
+    vk_labelled = module.new_signature_key()
+    agent = Agent(id=AgentId.random(), verification_key=vk_labelled)
+    key_id = module.new_encryption_key()
+    signed = module.sign_encryption_key(agent, key_id)
+    assert signed.signer == agent.id
+    assert signing.signature_is_valid(agent, signed)
+    # tampered body fails
+    from sda_tpu.protocol import B32, EncryptionKey, Labelled
+
+    signed.body = Labelled(signed.body.id, EncryptionKey(B32(bytes(32))))
+    assert not signing.signature_is_valid(agent, signed)
+    # claimed-signer mismatch raises
+    other = Agent(id=AgentId.random(), verification_key=vk_labelled)
+    with pytest.raises(ValueError):
+        signing.signature_is_valid(other, signed)
+
+
+@pytest.mark.parametrize(
+    "scheme",
+    [NoMasking(), FullMasking(433), ChaChaMasking(433, 10, 128)],
+    ids=["none", "full", "chacha"],
+)
+def test_masking_roundtrip(scheme):
+    secrets = np.arange(10, dtype=np.int64)
+    masker = masking.new_secret_masker(scheme)
+    combiner = masking.new_mask_combiner(scheme)
+    unmasker = masking.new_secret_unmasker(scheme)
+    mask1, masked1 = masker.mask(secrets)
+    mask2, masked2 = masker.mask(secrets)
+    total_mask = combiner.combine([mask1, mask2])
+    total_masked = rust_rem_np(masked1 + masked2, 433)
+    got = positive(unmasker.unmask(total_mask, total_masked), 433)
+    np.testing.assert_array_equal(got, (2 * secrets) % 433)
+
+
+@pytest.mark.parametrize(
+    "scheme",
+    [AdditiveSharing(3, 433), PACKED],
+    ids=["additive", "packed"],
+)
+def test_sharing_end_to_end(scheme):
+    dim = 10
+    p = 433
+    secrets1 = np.arange(dim, dtype=np.int64)
+    secrets2 = (np.arange(dim, dtype=np.int64) * 3) % p
+    gen = sharing.new_share_generator(scheme)
+    combiner = sharing.new_share_combiner(scheme)
+    recon = sharing.new_secret_reconstructor(scheme, dim)
+
+    shares1 = gen.generate(secrets1)  # (n, per_clerk)
+    shares2 = gen.generate(secrets2)
+    assert shares1.shape[0] == scheme.output_size
+
+    # each clerk combines its two participants' share vectors
+    combined = [combiner.combine([shares1[c], shares2[c]]) for c in range(shares1.shape[0])]
+    indexed = list(enumerate(combined))[: scheme.reconstruction_threshold]
+    got = positive(recon.reconstruct(indexed), p)
+    np.testing.assert_array_equal(got, (secrets1 + secrets2) % p)
+
+
+def test_packed_sharing_dropout_any_subset():
+    dim = 7  # not a multiple of secret_count: exercises pad + truncate
+    p = 433
+    secrets = np.arange(dim, dtype=np.int64) * 5 % p
+    gen = sharing.new_share_generator(PACKED)
+    recon = sharing.new_secret_reconstructor(PACKED, dim)
+    shares = gen.generate(secrets)
+    # clerks 0 and 5 drop out; any 7 of 8 suffice (reconstruction_threshold)
+    indexed = [(i, shares[i]) for i in (1, 2, 3, 4, 6, 7, 5)]
+    got = positive(recon.reconstruct(indexed), p)
+    np.testing.assert_array_equal(got, secrets)
+
+
+def test_keystore_alias_roundtrip(tmp_path):
+    from sda_tpu.crypto import Filebased
+    from sda_tpu.protocol import Labelled, VerificationKey, VerificationKeyId
+
+    store = Filebased(tmp_path)
+    agent = Agent(
+        id=AgentId.random(),
+        verification_key=Labelled(VerificationKeyId.random(), VerificationKey(bytes(32))),
+    )
+    store.put_aliased("agent", agent)
+    got = store.get_aliased("agent", Agent.from_json)
+    assert got == agent
