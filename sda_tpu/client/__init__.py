@@ -1,0 +1,41 @@
+"""sda_tpu.client — participant / clerk / recipient role logic.
+
+``SdaClient`` works against any ``SdaService`` (in-process server or REST
+proxy) with a keystore-backed ``CryptoModule`` — the same structure as the
+reference's client crate (client/src/lib.rs:39-56).
+"""
+
+from __future__ import annotations
+
+from ..crypto import CryptoModule, Keystore
+from ..protocol import Agent, AgentId, SdaService
+from .clerk import Clerking
+from .participate import Participating
+from .profile import Maintenance
+from .receive import Receiving, RecipientOutput
+
+
+class SdaClient(Participating, Clerking, Receiving, Maintenance):
+    """Primary object for interacting with an SDA service."""
+
+    def __init__(self, agent: Agent, keystore: Keystore, service: SdaService):
+        self.agent = agent
+        self.crypto = CryptoModule(keystore)
+        self.service = service
+
+    @staticmethod
+    def new_agent(keystore: Keystore) -> Agent:
+        """Create a fresh agent identity with a signature keypair
+        (client/src/profile.rs:10-18)."""
+        crypto = CryptoModule(keystore)
+        return Agent(id=AgentId.random(), verification_key=crypto.new_signature_key())
+
+
+__all__ = [
+    "SdaClient",
+    "Participating",
+    "Clerking",
+    "Receiving",
+    "Maintenance",
+    "RecipientOutput",
+]

@@ -1,0 +1,75 @@
+"""Clerk role: poll queue, decrypt, combine, re-encrypt to recipient.
+
+Mirrors /root/reference/client/src/clerk.rs. The hot loop — decrypt every
+participant's share vector and sum mod m — runs as one stacked numpy
+reduction instead of the reference's per-vector accumulate (clerk.rs:71-73
+notes that split wastes memory; the combiner here consumes the whole batch
+at once).
+"""
+
+from __future__ import annotations
+
+from ..crypto import signing
+from ..protocol import ClerkingResult
+
+
+class Clerking:
+    def clerk_once(self) -> bool:
+        """Process the next pending job, if any; returns whether one ran."""
+        job = self.service.get_clerking_job(self.agent, self.agent.id)
+        if job is None:
+            return False
+        result = self.process_clerking_job(job)
+        self.service.create_clerking_result(self.agent, result)
+        return True
+
+    def run_chores(self, max_iterations: int) -> None:
+        """Clerk repeatedly; negative means drain until no work is left."""
+        if max_iterations < 0:
+            while self.clerk_once():
+                pass
+        else:
+            for _ in range(max_iterations):
+                if not self.clerk_once():
+                    break
+
+    def process_clerking_job(self, job) -> ClerkingResult:
+        aggregation = self.service.get_aggregation(self.agent, job.aggregation)
+        if aggregation is None:
+            raise ValueError("Unknown aggregation")
+        committee = self.service.get_committee(self.agent, job.aggregation)
+        if committee is None:
+            raise ValueError("Unknown committee")
+
+        # which of our encryption keys was used
+        own_key_id = next(
+            (key for (clerk, key) in committee.clerks_and_keys if clerk == self.agent.id),
+            None,
+        )
+        if own_key_id is None:
+            raise ValueError("Could not find own encryption key in keyset")
+
+        decryptor = self.crypto.new_share_decryptor(
+            own_key_id, aggregation.committee_encryption_scheme
+        )
+        share_vectors = [decryptor.decrypt(e) for e in job.encryptions]
+
+        combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
+        combined = combiner.combine(share_vectors)
+
+        # fetch + verify recipient key, re-encrypt the combined vector
+        recipient = self.service.get_agent(self.agent, aggregation.recipient)
+        if recipient is None:
+            raise ValueError("Unknown recipient")
+        signed_key = self.service.get_encryption_key(self.agent, aggregation.recipient_key)
+        if signed_key is None:
+            raise ValueError("Unknown recipient encryption key")
+        if not signing.signature_is_valid(recipient, signed_key):
+            raise ValueError("Signature verification failed for recipient key")
+        encryptor = self.crypto.new_share_encryptor(
+            signed_key.body.body, aggregation.recipient_encryption_scheme
+        )
+
+        return ClerkingResult(
+            job=job.id, clerk=job.clerk, encryption=encryptor.encrypt(combined)
+        )

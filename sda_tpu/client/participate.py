@@ -1,0 +1,86 @@
+"""Participant role: mask, share, seal, upload.
+
+Mirrors /root/reference/client/src/participate.rs:37-113: fetch aggregation
+and committee, mask the secrets (optionally sealing the mask to the
+recipient), share the masked vector across the committee, then per clerk
+fetch + signature-verify the encryption key and seal that clerk's share
+vector. ``new_participation`` is separate from upload so retries are
+idempotent under the client-chosen ParticipationId.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto import signing
+from ..protocol import Participation, ParticipationId
+
+
+class Participating:
+    def participate(self, values, aggregation_id) -> None:
+        participation = self.new_participation(values, aggregation_id)
+        self.upload_participation(participation)
+
+    def upload_participation(self, participation) -> None:
+        self.service.create_participation(self.agent, participation)
+
+    def _fetch_verified_key(self, agent_id, key_id):
+        """Fetch a signed encryption key + its owner, verify the signature."""
+        signed_key = self.service.get_encryption_key(self.agent, key_id)
+        if signed_key is None:
+            raise ValueError("Unknown encryption key")
+        owner = self.service.get_agent(self.agent, agent_id)
+        if owner is None:
+            raise ValueError("Unknown agent")
+        if not signing.signature_is_valid(owner, signed_key):
+            raise ValueError("Signature verification failed for key")
+        return signed_key.body.body  # the EncryptionKey
+
+    def new_participation(self, values, aggregation_id) -> Participation:
+        secrets = np.asarray(values, dtype=np.int64)
+
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise ValueError("Could not find aggregation")
+        if len(secrets) != aggregation.vector_dimension:
+            raise ValueError("The input length does not match the aggregation.")
+
+        committee = self.service.get_committee(self.agent, aggregation_id)
+        if committee is None:
+            raise ValueError("Could not find committee")
+
+        # mask the secrets
+        masker = self.crypto.new_secret_masker(aggregation.masking_scheme)
+        recipient_mask, masked_secrets = masker.mask(secrets)
+
+        recipient_encryption = None
+        if len(recipient_mask) > 0:
+            recipient_key = self._fetch_verified_key(
+                aggregation.recipient, aggregation.recipient_key
+            )
+            mask_encryptor = self.crypto.new_share_encryptor(
+                recipient_key, aggregation.recipient_encryption_scheme
+            )
+            recipient_encryption = mask_encryptor.encrypt(recipient_mask)
+
+        # share the masked secrets: one share vector per clerk
+        generator = self.crypto.new_share_generator(aggregation.committee_sharing_scheme)
+        shares_per_clerk = generator.generate(masked_secrets)  # (n_clerks, len)
+
+        clerk_encryptions = []
+        for clerk_index, (clerk_id, clerk_key_id) in enumerate(committee.clerks_and_keys):
+            clerk_key = self._fetch_verified_key(clerk_id, clerk_key_id)
+            share_encryptor = self.crypto.new_share_encryptor(
+                clerk_key, aggregation.committee_encryption_scheme
+            )
+            clerk_encryptions.append(
+                (clerk_id, share_encryptor.encrypt(shares_per_clerk[clerk_index]))
+            )
+
+        return Participation(
+            id=ParticipationId.random(),
+            participant=self.agent.id,
+            aggregation=aggregation.id,
+            recipient_encryption=recipient_encryption,
+            clerk_encryptions=clerk_encryptions,
+        )

@@ -1,0 +1,21 @@
+"""Identity maintenance tasks (reference: client/src/profile.rs)."""
+
+from __future__ import annotations
+
+
+class Maintenance:
+    """Upload agent identity and create/upload signed encryption keys."""
+
+    def upload_agent(self) -> None:
+        self.service.create_agent(self.agent, self.agent)
+
+    def new_encryption_key(self):
+        """Create a new encryption keypair in the keystore; returns its id."""
+        return self.crypto.new_encryption_key()
+
+    def upload_encryption_key(self, key_id) -> None:
+        """Sign the public key with the agent's signature key and upload."""
+        signed = self.crypto.sign_encryption_key(self.agent, key_id)
+        if signed is None:
+            raise ValueError("Could not sign encryption key")
+        self.service.create_encryption_key(self.agent, signed)

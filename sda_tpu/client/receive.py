@@ -1,0 +1,109 @@
+"""Recipient role: create/open/close aggregations and reveal results.
+
+Mirrors /root/reference/client/src/receive.rs: committee election follows
+the service suggestion blindly (first output_size candidates), closing
+creates one snapshot if none exists, and reveal decrypts + combines masks,
+decrypts clerk results into indexed share vectors, reconstructs, and
+unmasks. ``RecipientOutput.positive()`` lifts truncated-remainder residues
+into [0, m) (receive.rs:8-21).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..crypto import signing
+from ..ops.modular import positive
+from ..protocol import Committee, Snapshot, SnapshotId
+
+
+@dataclass
+class RecipientOutput:
+    modulus: int
+    values: np.ndarray
+
+    def positive(self) -> "RecipientOutput":
+        return RecipientOutput(self.modulus, positive(self.values, self.modulus))
+
+
+class Receiving:
+    def upload_aggregation(self, aggregation) -> None:
+        self.service.create_aggregation(self.agent, aggregation)
+
+    def begin_aggregation(self, aggregation_id) -> None:
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise ValueError(f"Unknown aggregation {aggregation_id}")
+        candidates = self.service.suggest_committee(self.agent, aggregation_id)
+        size = aggregation.committee_sharing_scheme.output_size
+        selected = [(c.id, c.keys[0]) for c in candidates[:size]]
+        self.service.create_committee(
+            self.agent, Committee(aggregation=aggregation_id, clerks_and_keys=selected)
+        )
+
+    def end_aggregation(self, aggregation_id) -> None:
+        status = self.service.get_aggregation_status(self.agent, aggregation_id)
+        if status is None:
+            raise ValueError("Unknown aggregation")
+        if len(status.snapshots) >= 1:
+            return
+        self.service.create_snapshot(
+            self.agent, Snapshot(id=SnapshotId.random(), aggregation=aggregation_id)
+        )
+
+    def reveal_aggregation(self, aggregation_id) -> RecipientOutput:
+        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        if aggregation is None:
+            raise ValueError(f"Unknown aggregation {aggregation_id}")
+        committee = self.service.get_committee(self.agent, aggregation_id)
+        if committee is None:
+            raise ValueError(f"Unknown committee {aggregation_id}")
+
+        status = self.service.get_aggregation_status(self.agent, aggregation_id)
+        if status is None:
+            raise ValueError("Unknown aggregation")
+        ready = [s for s in status.snapshots if s.result_ready]
+        if not ready:
+            raise ValueError("Aggregation not ready")
+        result = self.service.get_snapshot_result(self.agent, aggregation_id, ready[0].id)
+        if result is None:
+            raise ValueError("Missing aggregation result")
+
+        # one decryptor serves both mask and clerk-result payloads (same key)
+        decryptor = self.crypto.new_share_decryptor(
+            aggregation.recipient_key, aggregation.recipient_encryption_scheme
+        )
+
+        # decrypt and combine masks
+        if result.recipient_encryptions is None:
+            mask = np.empty(0, dtype=np.int64)
+        else:
+            decrypted = [decryptor.decrypt(e) for e in result.recipient_encryptions]
+            mask_combiner = self.crypto.new_mask_combiner(aggregation.masking_scheme)
+            mask = mask_combiner.combine(decrypted)
+
+        # decrypt clerk results into (committee index, share vector) pairs
+        clerk_positions = {
+            clerk: ix for ix, (clerk, _) in enumerate(committee.clerks_and_keys)
+        }
+        indexed_shares = []
+        for clerking_result in result.clerk_encryptions:
+            if clerking_result.clerk not in clerk_positions:
+                raise ValueError(f"Missing clerk {clerking_result.clerk}")
+            indexed_shares.append(
+                (
+                    clerk_positions[clerking_result.clerk],
+                    decryptor.decrypt(clerking_result.encryption),
+                )
+            )
+
+        reconstructor = self.crypto.new_secret_reconstructor(
+            aggregation.committee_sharing_scheme, aggregation.vector_dimension
+        )
+        masked_output = reconstructor.reconstruct(indexed_shares)
+
+        unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
+        output = unmasker.unmask(mask, masked_output)
+        return RecipientOutput(modulus=aggregation.modulus, values=output)

@@ -1,0 +1,70 @@
+"""sda_tpu.server — orchestration server, stores, snapshot pipeline."""
+
+from __future__ import annotations
+
+from .memstore import (
+    MemAgentsStore,
+    MemAggregationsStore,
+    MemAuthTokensStore,
+    MemClerkingJobsStore,
+)
+from .service import SdaServer, SdaServerService
+from .stores import (
+    AggregationsStore,
+    AgentsStore,
+    AuthToken,
+    AuthTokensStore,
+    BaseStore,
+    ClerkingJobsStore,
+)
+
+
+def new_mem_server() -> SdaServerService:
+    """In-memory server (tests / dev)."""
+    return SdaServerService(
+        SdaServer(
+            agents_store=MemAgentsStore(),
+            auth_tokens_store=MemAuthTokensStore(),
+            aggregation_store=MemAggregationsStore(),
+            clerking_job_store=MemClerkingJobsStore(),
+        )
+    )
+
+
+def new_file_server(path) -> SdaServerService:
+    """Durable JSON-file-backed server (the reference's jfs equivalent)."""
+    from .filestore import (
+        FileAgentsStore,
+        FileAggregationsStore,
+        FileAuthTokensStore,
+        FileClerkingJobsStore,
+    )
+
+    import os
+
+    return SdaServerService(
+        SdaServer(
+            agents_store=FileAgentsStore(os.path.join(path, "agents")),
+            auth_tokens_store=FileAuthTokensStore(os.path.join(path, "auths")),
+            aggregation_store=FileAggregationsStore(os.path.join(path, "agg")),
+            clerking_job_store=FileClerkingJobsStore(os.path.join(path, "jobs")),
+        )
+    )
+
+
+__all__ = [
+    "SdaServer",
+    "SdaServerService",
+    "new_mem_server",
+    "new_file_server",
+    "BaseStore",
+    "AuthToken",
+    "AuthTokensStore",
+    "AgentsStore",
+    "AggregationsStore",
+    "ClerkingJobsStore",
+    "MemAgentsStore",
+    "MemAuthTokensStore",
+    "MemAggregationsStore",
+    "MemClerkingJobsStore",
+]

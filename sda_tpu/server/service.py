@@ -1,0 +1,293 @@
+"""SdaServer core and its ACL-enforcing service wrapper.
+
+``SdaServer`` delegates every RPC to the four stores (reference:
+server/src/server.rs:23-191); ``SdaServerService`` implements the protocol's
+``SdaService`` interface on top, adding per-route access control exactly as
+server.rs:193-361: recipient-only guards on all recipient routes, caller ==
+subject on create/upsert routes, and the clerk-job ownership double check on
+result submission.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..protocol import (
+    AggregationStatus,
+    InvalidCredentialsError,
+    InvalidRequestError,
+    PermissionDeniedError,
+    Pong,
+    SdaService,
+    ServerError,
+    SnapshotResult,
+    SnapshotStatus,
+)
+from . import snapshot as snapshot_mod
+
+
+class SdaServer:
+    def __init__(self, agents_store, auth_tokens_store, aggregation_store, clerking_job_store):
+        self.agents_store = agents_store
+        self.auth_tokens_store = auth_tokens_store
+        self.aggregation_store = aggregation_store
+        self.clerking_job_store = clerking_job_store
+
+    # -- base --------------------------------------------------------------
+
+    def ping(self) -> Pong:
+        self.agents_store.ping()
+        return Pong(running=True)
+
+    # -- agents ------------------------------------------------------------
+
+    def create_agent(self, agent) -> None:
+        self.agents_store.create_agent(agent)
+
+    def get_agent(self, agent_id):
+        return self.agents_store.get_agent(agent_id)
+
+    def upsert_profile(self, profile) -> None:
+        self.agents_store.upsert_profile(profile)
+
+    def get_profile(self, agent_id):
+        return self.agents_store.get_profile(agent_id)
+
+    def create_encryption_key(self, key) -> None:
+        self.agents_store.create_encryption_key(key)
+
+    def get_encryption_key(self, key_id):
+        return self.agents_store.get_encryption_key(key_id)
+
+    # -- aggregations --------------------------------------------------------
+
+    def list_aggregations(self, filter, recipient):
+        return self.aggregation_store.list_aggregations(filter, recipient)
+
+    def get_aggregation(self, aggregation_id):
+        return self.aggregation_store.get_aggregation(aggregation_id)
+
+    def get_committee(self, aggregation_id):
+        return self.aggregation_store.get_committee(aggregation_id)
+
+    def create_aggregation(self, aggregation) -> None:
+        self.aggregation_store.create_aggregation(aggregation)
+
+    def delete_aggregation(self, aggregation_id) -> None:
+        self.aggregation_store.delete_aggregation(aggregation_id)
+
+    def suggest_committee(self, aggregation_id):
+        if self.aggregation_store.get_aggregation(aggregation_id) is None:
+            raise ServerError("aggregation not found")
+        return self.agents_store.suggest_committee()
+
+    def create_committee(self, committee) -> None:
+        agg = self.aggregation_store.get_aggregation(committee.aggregation)
+        if agg is None:
+            raise ServerError("aggregation not found")
+        expected = agg.committee_sharing_scheme.output_size
+        if expected != len(committee.clerks_and_keys):
+            raise InvalidRequestError(
+                f"Expected {expected} clerks in the committee, "
+                f"found {len(committee.clerks_and_keys)} instead"
+            )
+        self.aggregation_store.create_committee(committee)
+
+    def create_participation(self, participation) -> None:
+        # Validate the clerk-encryption list against the committee: the
+        # snapshot transpose routes ciphertexts to clerks *by position*
+        # (stores.iter_snapshot_clerk_jobs_data), so a short/long/misordered
+        # list would crash snapshotting or silently corrupt the aggregate.
+        # (The reference accepts these unchecked — a deliberate hardening.)
+        committee = self.aggregation_store.get_committee(participation.aggregation)
+        if committee is None:
+            raise InvalidRequestError("no committee for aggregation")
+        expected = [clerk for (clerk, _) in committee.clerks_and_keys]
+        got = [clerk for (clerk, _) in participation.clerk_encryptions]
+        if got != expected:
+            raise InvalidRequestError(
+                "participation clerk encryptions do not match the committee"
+            )
+        self.aggregation_store.create_participation(participation)
+
+    def get_aggregation_status(self, aggregation_id) -> Optional[AggregationStatus]:
+        agg = self.aggregation_store.get_aggregation(aggregation_id)
+        if agg is None:
+            return None
+        snapshots = []
+        for snap_id in self.aggregation_store.list_snapshots(aggregation_id):
+            results_count = len(self.clerking_job_store.list_results(snap_id))
+            snapshots.append(
+                SnapshotStatus(
+                    id=snap_id,
+                    number_of_clerking_results=results_count,
+                    result_ready=results_count
+                    >= agg.committee_sharing_scheme.reconstruction_threshold,
+                )
+            )
+        return AggregationStatus(
+            aggregation=aggregation_id,
+            number_of_participations=self.aggregation_store.count_participations(
+                aggregation_id
+            ),
+            snapshots=snapshots,
+        )
+
+    def create_snapshot(self, snapshot) -> None:
+        snapshot_mod.run_snapshot(self, snapshot)
+
+    # -- clerking ------------------------------------------------------------
+
+    def poll_clerking_job(self, clerk_id):
+        return self.clerking_job_store.poll_clerking_job(clerk_id)
+
+    def get_clerking_job(self, clerk_id, job_id):
+        return self.clerking_job_store.get_clerking_job(clerk_id, job_id)
+
+    def create_clerking_result(self, result) -> None:
+        self.clerking_job_store.create_clerking_result(result)
+
+    def get_snapshot_result(self, aggregation_id, snapshot_id) -> Optional[SnapshotResult]:
+        # The snapshot must exist AND belong to this aggregation — otherwise
+        # a recipient could read another aggregation's results through their
+        # own ACL check (the reference marks this hole "FIXME no
+        # aggregation/snapshot spoofing", server.rs:324; fixed here).
+        if self.aggregation_store.get_snapshot(aggregation_id, snapshot_id) is None:
+            return None
+        results = []
+        for job_id in self.clerking_job_store.list_results(snapshot_id):
+            result = self.clerking_job_store.get_result(snapshot_id, job_id)
+            if result is None:
+                raise ServerError("inconsistent storage")
+            results.append(result)
+        return SnapshotResult(
+            snapshot=snapshot_id,
+            number_of_participations=self.aggregation_store.count_participations_snapshot(
+                aggregation_id, snapshot_id
+            ),
+            clerk_encryptions=results,
+            recipient_encryptions=self.aggregation_store.get_snapshot_mask(snapshot_id),
+        )
+
+    # -- auth ----------------------------------------------------------------
+
+    def upsert_auth_token(self, token) -> None:
+        self.auth_tokens_store.upsert_auth_token(token)
+
+    def check_auth_token(self, token):
+        stored = self.auth_tokens_store.get_auth_token(token.id)
+        if stored is not None and stored == token:
+            agent = self.agents_store.get_agent(token.id)
+            if agent is None:
+                raise InvalidCredentialsError("Agent not found")
+            return agent
+        raise InvalidCredentialsError("invalid token")
+
+    def delete_auth_token(self, agent_id) -> None:
+        self.auth_tokens_store.delete_auth_token(agent_id)
+
+
+def _acl_agent_is(caller, agent_id) -> None:
+    if caller.id != agent_id:
+        raise PermissionDeniedError(f"caller {caller.id} is not {agent_id}")
+
+
+class SdaServerService(SdaService):
+    """ACL wrapper: the in-process implementation of the service seam."""
+
+    def __init__(self, server: SdaServer):
+        self.server = server
+
+    def ping(self):
+        return self.server.ping()
+
+    # -- agents (ACL: caller must be the subject on writes) -------------------
+
+    def create_agent(self, caller, agent) -> None:
+        _acl_agent_is(caller, agent.id)
+        self.server.create_agent(agent)
+
+    def get_agent(self, caller, agent_id):
+        return self.server.get_agent(agent_id)
+
+    def upsert_profile(self, caller, profile) -> None:
+        _acl_agent_is(caller, profile.owner)
+        self.server.upsert_profile(profile)
+
+    def get_profile(self, caller, owner_id):
+        return self.server.get_profile(owner_id)
+
+    def create_encryption_key(self, caller, signed_key) -> None:
+        _acl_agent_is(caller, signed_key.signer)
+        self.server.create_encryption_key(signed_key)
+
+    def get_encryption_key(self, caller, key_id):
+        return self.server.get_encryption_key(key_id)
+
+    # -- aggregations (public reads) ------------------------------------------
+
+    def list_aggregations(self, caller, filter=None, recipient=None):
+        return self.server.list_aggregations(filter, recipient)
+
+    def get_aggregation(self, caller, aggregation_id):
+        return self.server.get_aggregation(aggregation_id)
+
+    def get_committee(self, caller, aggregation_id):
+        return self.server.get_committee(aggregation_id)
+
+    # -- recipient routes (ACL: caller must be the recipient) ------------------
+
+    def _acl_recipient(self, caller, aggregation_id):
+        agg = self.server.get_aggregation(aggregation_id)
+        if agg is None:
+            raise ServerError("No aggregation found")
+        _acl_agent_is(caller, agg.recipient)
+        return agg
+
+    def create_aggregation(self, caller, aggregation) -> None:
+        _acl_agent_is(caller, aggregation.recipient)
+        self.server.create_aggregation(aggregation)
+
+    def delete_aggregation(self, caller, aggregation_id) -> None:
+        self._acl_recipient(caller, aggregation_id)
+        self.server.delete_aggregation(aggregation_id)
+
+    def suggest_committee(self, caller, aggregation_id):
+        self._acl_recipient(caller, aggregation_id)
+        return self.server.suggest_committee(aggregation_id)
+
+    def create_committee(self, caller, committee) -> None:
+        self._acl_recipient(caller, committee.aggregation)
+        self.server.create_committee(committee)
+
+    def get_aggregation_status(self, caller, aggregation_id):
+        self._acl_recipient(caller, aggregation_id)
+        return self.server.get_aggregation_status(aggregation_id)
+
+    def create_snapshot(self, caller, snapshot) -> None:
+        self._acl_recipient(caller, snapshot.aggregation)
+        self.server.create_snapshot(snapshot)
+
+    def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
+        self._acl_recipient(caller, aggregation_id)
+        return self.server.get_snapshot_result(aggregation_id, snapshot_id)
+
+    # -- participation ---------------------------------------------------------
+
+    def create_participation(self, caller, participation) -> None:
+        _acl_agent_is(caller, participation.participant)
+        self.server.create_participation(participation)
+
+    # -- clerking --------------------------------------------------------------
+
+    def get_clerking_job(self, caller, clerk_id):
+        _acl_agent_is(caller, clerk_id)
+        return self.server.poll_clerking_job(clerk_id)
+
+    def create_clerking_result(self, caller, result) -> None:
+        # double check the job really belongs to the caller (server.rs:351-360)
+        job = self.server.get_clerking_job(result.clerk, result.job)
+        if job is None:
+            raise ServerError("Job not found")
+        _acl_agent_is(caller, job.clerk)
+        self.server.create_clerking_result(result)

@@ -1,0 +1,68 @@
+"""The snapshot pipeline — the server's orchestration heart.
+
+Mirrors /root/reference/server/src/snapshot.rs:4-47: freeze the current
+participation set, transpose the (participants x clerks) ciphertext matrix,
+enqueue one durable ClerkingJob per committee member, persist the snapshot,
+and (when the scheme masks) collect every participation's recipient
+encryption into the snapshot mask blob.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..protocol import ClerkingJob, ClerkingJobId, ServerError
+
+log = logging.getLogger("sda.server.snapshot")
+
+
+def run_snapshot(server, snapshot) -> None:
+    aggregation = server.aggregation_store.get_aggregation(snapshot.aggregation)
+    if aggregation is None:
+        raise ServerError("lost aggregation")
+
+    # Idempotent retry: the snapshot id is client-chosen; re-submitting an
+    # existing snapshot must not enqueue a second set of clerking jobs
+    # (duplicate results would double-count toward result_ready).
+    if server.aggregation_store.get_snapshot(snapshot.aggregation, snapshot.id) is not None:
+        log.debug("snapshot %s: already exists, retry is a no-op", snapshot.id)
+        return
+
+    log.debug("snapshot %s: freezing participations", snapshot.id)
+    server.aggregation_store.snapshot_participations(snapshot.aggregation, snapshot.id)
+
+    committee = server.aggregation_store.get_committee(snapshot.aggregation)
+    if committee is None:
+        raise ServerError("lost committee")
+
+    log.debug("snapshot %s: transposing encryptions", snapshot.id)
+    per_clerk = server.aggregation_store.iter_snapshot_clerk_jobs_data(
+        snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
+    )
+
+    log.debug("snapshot %s: enqueueing clerking jobs", snapshot.id)
+    for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, per_clerk):
+        server.clerking_job_store.enqueue_clerking_job(
+            ClerkingJob(
+                id=ClerkingJobId.random(),
+                clerk=clerk_id,
+                aggregation=snapshot.aggregation,
+                snapshot=snapshot.id,
+                encryptions=encryptions,
+            )
+        )
+
+    server.aggregation_store.create_snapshot(snapshot)
+
+    if aggregation.masking_scheme.has_mask():
+        log.debug("snapshot %s: collecting masking data", snapshot.id)
+        recipient_encryptions = []
+        for part in server.aggregation_store.iter_snapped_participations(
+            snapshot.aggregation, snapshot.id
+        ):
+            if part.recipient_encryption is None:
+                raise ServerError("participation should have had a recipient encryption")
+            recipient_encryptions.append(part.recipient_encryption)
+        server.aggregation_store.create_snapshot_mask(snapshot.id, recipient_encryptions)
+
+    log.debug("snapshot %s: done", snapshot.id)

@@ -1,0 +1,153 @@
+"""Storage abstraction of the orchestration server.
+
+Four store interfaces mirroring /root/reference/server/src/stores.rs: agents,
+auth tokens, aggregations (incl. participations/snapshots/masks), and
+clerking jobs (durable per-clerk pull queues). The server core only talks to
+these interfaces; backends plug in underneath (memory, file, sqlite).
+
+``iter_snapshot_clerk_jobs_data`` is the server's one nontrivial computation:
+transposing the (participants x clerks) ciphertext matrix into per-clerk job
+payloads (stores.rs:86-101). Backends may override it with something
+smarter (the reference's mongo store runs it as an aggregation pipeline with
+disk spill; the TPU fabric does it as an all_to_all when tensor-resident).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterator, Optional
+
+from ..protocol import Labelled
+
+# AuthToken = Labelled[AgentId, str] (stores.rs:8)
+AuthToken = Labelled
+
+
+class BaseStore(abc.ABC):
+    def ping(self) -> None:
+        """Raise if the backend is unhealthy."""
+
+
+class AuthTokensStore(BaseStore):
+    @abc.abstractmethod
+    def upsert_auth_token(self, token: AuthToken) -> None: ...
+
+    @abc.abstractmethod
+    def get_auth_token(self, agent_id) -> Optional[AuthToken]: ...
+
+    @abc.abstractmethod
+    def delete_auth_token(self, agent_id) -> None: ...
+
+
+class AgentsStore(BaseStore):
+    @abc.abstractmethod
+    def create_agent(self, agent) -> None: ...
+
+    @abc.abstractmethod
+    def get_agent(self, agent_id): ...
+
+    @abc.abstractmethod
+    def upsert_profile(self, profile) -> None: ...
+
+    @abc.abstractmethod
+    def get_profile(self, owner_id): ...
+
+    @abc.abstractmethod
+    def create_encryption_key(self, signed_key) -> None: ...
+
+    @abc.abstractmethod
+    def get_encryption_key(self, key_id): ...
+
+    @abc.abstractmethod
+    def suggest_committee(self) -> list:
+        """All agents holding at least one registered key, as ClerkCandidates
+        (reference jfs impl groups signed keys by signer, agents.rs:66-83)."""
+
+
+class AggregationsStore(BaseStore):
+    @abc.abstractmethod
+    def list_aggregations(self, filter: Optional[str], recipient) -> list: ...
+
+    @abc.abstractmethod
+    def create_aggregation(self, aggregation) -> None: ...
+
+    @abc.abstractmethod
+    def get_aggregation(self, aggregation_id): ...
+
+    @abc.abstractmethod
+    def delete_aggregation(self, aggregation_id) -> None: ...
+
+    @abc.abstractmethod
+    def get_committee(self, aggregation_id): ...
+
+    @abc.abstractmethod
+    def create_committee(self, committee) -> None: ...
+
+    @abc.abstractmethod
+    def create_participation(self, participation) -> None: ...
+
+    @abc.abstractmethod
+    def create_snapshot(self, snapshot) -> None: ...
+
+    @abc.abstractmethod
+    def list_snapshots(self, aggregation_id) -> list: ...
+
+    @abc.abstractmethod
+    def get_snapshot(self, aggregation_id, snapshot_id): ...
+
+    @abc.abstractmethod
+    def count_participations(self, aggregation_id) -> int: ...
+
+    @abc.abstractmethod
+    def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
+        """Freeze the current participation set as the snapshot's members."""
+
+    @abc.abstractmethod
+    def iter_snapped_participations(self, aggregation_id, snapshot_id) -> Iterator: ...
+
+    def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
+        return sum(1 for _ in self.iter_snapped_participations(aggregation_id, snapshot_id))
+
+    def iter_snapshot_clerk_jobs_data(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> list:
+        """Transpose participations x clerks -> per-clerk ciphertext lists.
+
+        Default in-memory transpose (stores.rs:86-101); column ix is the
+        clerk's committee position (participations carry clerk encryptions
+        in committee order).
+        """
+        shares: list = [[] for _ in range(clerks_number)]
+        for participation in self.iter_snapped_participations(aggregation_id, snapshot_id):
+            for ix, (_, enc) in enumerate(participation.clerk_encryptions):
+                shares[ix].append(enc)
+        return shares
+
+    @abc.abstractmethod
+    def create_snapshot_mask(self, snapshot_id, mask: list) -> None: ...
+
+    @abc.abstractmethod
+    def get_snapshot_mask(self, snapshot_id): ...
+
+
+class ClerkingJobsStore(BaseStore):
+    @abc.abstractmethod
+    def enqueue_clerking_job(self, job) -> None: ...
+
+    @abc.abstractmethod
+    def poll_clerking_job(self, clerk_id):
+        """First not-yet-done job for the clerk; jobs stay queued until a
+        result is posted, so a crashed clerk re-polls the same job
+        (jfs_stores/clerking_jobs.rs:40-59)."""
+
+    @abc.abstractmethod
+    def get_clerking_job(self, clerk_id, job_id): ...
+
+    @abc.abstractmethod
+    def create_clerking_result(self, result) -> None: ...
+
+    @abc.abstractmethod
+    def list_results(self, snapshot_id) -> list: ...
+
+    @abc.abstractmethod
+    def get_result(self, snapshot_id, job_id): ...

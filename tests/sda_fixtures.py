@@ -1,0 +1,98 @@
+"""Shared test fixtures: the trait-seam environment matrix.
+
+Port of /root/reference/integration-tests/src/lib.rs: ``with_service`` runs
+a test body against the in-process service by default, and against a real
+REST stack when SDA_TEST_HTTP=1 (same test bodies, different binding) —
+the reference's feature-flag matrix as an env switch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+from sda_tpu.client import SdaClient
+from sda_tpu.crypto import Keystore
+from sda_tpu.protocol import (
+    Agent,
+    AgentId,
+    B32,
+    B64,
+    EncryptionKey,
+    EncryptionKeyId,
+    Labelled,
+    Signature,
+    Signed,
+    VerificationKey,
+    VerificationKeyId,
+)
+from sda_tpu.server import new_mem_server
+
+
+class TestContext:
+    def __init__(self, server, service):
+        self.server = server
+        self.service = service
+
+
+@contextlib.contextmanager
+def with_server():
+    if os.environ.get("SDA_TEST_STORE") == "file":
+        from sda_tpu.server import new_file_server
+
+        with tempfile.TemporaryDirectory() as tmp:
+            server = new_file_server(tmp)
+            yield TestContext(server=server, service=server)
+        return
+    server = new_mem_server()
+    yield TestContext(server=server, service=server)
+
+
+@contextlib.contextmanager
+def with_service():
+    use_http = os.environ.get("SDA_TEST_HTTP") == "1"
+    with with_server() as ctx:
+        if not use_http:
+            yield ctx
+            return
+        from sda_tpu.rest.client import SdaHttpClient
+        from sda_tpu.rest.server import serve_background
+        from sda_tpu.rest.tokenstore import TokenStore
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with serve_background(ctx.server) as base_url:
+                client = SdaHttpClient(base_url, TokenStore(tmp))
+                yield TestContext(server=ctx.server, service=client)
+
+
+def new_agent() -> Agent:
+    """Mock agent with all-zero keys — fine in-process because the server
+    never verifies signatures (verification is client-side only)."""
+    return Agent(
+        id=AgentId.random(),
+        verification_key=Labelled(VerificationKeyId.random(), VerificationKey(B32(bytes(32)))),
+    )
+
+
+def new_key_for_agent(agent: Agent) -> Signed:
+    return Signed(
+        signature=Signature(B64(bytes(64))),
+        signer=agent.id,
+        body=Labelled(EncryptionKeyId.random(), EncryptionKey(B32(bytes(32)))),
+    )
+
+
+def new_full_agent(service):
+    agent = new_agent()
+    service.create_agent(agent, agent)
+    key = new_key_for_agent(agent)
+    service.create_encryption_key(agent, key)
+    return agent, key
+
+
+def new_client(tmpdir, service) -> SdaClient:
+    """A real crypto-enabled client over a temp keystore."""
+    keystore = Keystore(tmpdir)
+    agent = SdaClient.new_agent(keystore)
+    return SdaClient(agent, keystore, service)

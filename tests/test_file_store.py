@@ -1,0 +1,66 @@
+"""File-store backend: the full e2e matrix must behave identically on the
+durable backend, including across a simulated server restart."""
+
+import numpy as np
+
+from sda_fixtures import new_client
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    EncryptionKeyId,
+    FullMasking,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.server import new_file_server
+
+
+def test_full_loop_on_file_store_with_restart(tmp_path):
+    store_dir = tmp_path / "server"
+    service = new_file_server(store_dir)
+
+    recipient = new_client(tmp_path / "recipient", service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="foo",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=FullMasking(modulus=433),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+
+    clerks = [new_client(tmp_path / f"clerk{i}", service) for i in range(3)]
+    for clerk in clerks:
+        key = clerk.new_encryption_key()
+        clerk.upload_agent()
+        clerk.upload_encryption_key(key)
+
+    recipient.begin_aggregation(agg.id)
+    for i in range(2):
+        part = new_client(tmp_path / f"part{i}", service)
+        part.upload_agent()
+        part.participate([1, 2, 3, 4], agg.id)
+    recipient.end_aggregation(agg.id)
+
+    # "restart" the server: new process state over the same directory;
+    # durable queues and snapshots must survive (SURVEY.md §5).
+    service2 = new_file_server(store_dir)
+    recipient.service = service2
+    members = {c for c, _ in service2.get_committee(recipient.agent, agg.id).clerks_and_keys}
+    for client in [recipient] + clerks:
+        client.service = service2
+        if client.agent.id in members:
+            client.run_chores(-1)
+
+    out = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(out.positive().values, [2, 4, 6, 8])

@@ -1,0 +1,121 @@
+"""End-to-end crypto tests over the full protocol (reference:
+integration-tests/tests/full_loop.rs): recipient + 8 clerks + 2 participants
+with real keys, real sodium, real sharing, through committee election,
+participation, snapshot, chore loops, and reveal — asserting the exact sum.
+"""
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, with_service
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+
+
+def agg_default() -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="foo",
+        vector_dimension=4,
+        modulus=433,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+def check_full_aggregation(aggregation: Aggregation, tmp_path):
+    with with_service() as ctx:
+        # prepare recipient
+        recipient = new_client(tmp_path / "recipient", ctx.service)
+        recipient_key = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(recipient_key)
+
+        aggregation.recipient = recipient.agent.id
+        aggregation.recipient_key = recipient_key
+        recipient.upload_aggregation(aggregation)
+
+        # prepare clerks
+        clerks = [new_client(tmp_path / f"clerk{i}", ctx.service) for i in range(8)]
+        for clerk in clerks:
+            clerk_key = clerk.new_encryption_key()
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk_key)
+
+        # assign committee
+        recipient.begin_aggregation(aggregation.id)
+
+        # participate
+        participants = [new_client(tmp_path / f"part{i}", ctx.service) for i in range(2)]
+        for participant in participants:
+            participant.upload_agent()
+            participant.participate([1, 2, 3, 4], aggregation.id)
+
+        # close aggregation (creates snapshot)
+        recipient.end_aggregation(aggregation.id)
+
+        status = ctx.service.get_aggregation_status(recipient.agent, aggregation.id)
+        assert status.aggregation == aggregation.id
+        assert status.number_of_participations == len(participants)
+        assert len(status.snapshots) == 1
+        assert status.snapshots[0].number_of_clerking_results == 0
+        assert not status.snapshots[0].result_ready
+
+        # perform clerking (recipient may also be a committee member)
+        recipient.run_chores(-1)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+
+        status = ctx.service.get_aggregation_status(recipient.agent, aggregation.id)
+        assert (
+            status.snapshots[0].number_of_clerking_results
+            == aggregation.committee_sharing_scheme.output_size
+        )
+        assert status.snapshots[0].result_ready
+
+        # reveal
+        output = recipient.reveal_aggregation(aggregation.id)
+        np.testing.assert_array_equal(output.positive().values, [2, 4, 6, 8])
+
+
+def test_simple(tmp_path):
+    check_full_aggregation(agg_default(), tmp_path)
+
+
+def test_with_fullmask(tmp_path):
+    agg = agg_default()
+    agg.masking_scheme = FullMasking(modulus=433)
+    check_full_aggregation(agg, tmp_path)
+
+
+def test_with_chachamask(tmp_path):
+    agg = agg_default()
+    agg.masking_scheme = ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128)
+    check_full_aggregation(agg, tmp_path)
+
+
+def test_with_packedshamir(tmp_path):
+    agg = agg_default()
+    agg.committee_sharing_scheme = PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=433,
+        omega_secrets=354,
+        omega_shares=150,
+    )
+    check_full_aggregation(agg, tmp_path)

@@ -1,0 +1,196 @@
+"""Orchestration-only tests with fake crypto (reference:
+integration-tests/tests/service.rs): drive the whole protocol with 2-byte
+marker "ciphertexts" and assert the server-side transpose routed exactly the
+right bytes to each clerk, queues drain, and status gates flip. Plus
+regression tests for server hardening (snapshot retry idempotence,
+participation validation, snapshot spoofing).
+"""
+
+import pytest
+
+from sda_fixtures import new_full_agent, with_service
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    Binary,
+    ClerkingResult,
+    Committee,
+    Encryption,
+    InvalidRequestError,
+    NoMasking,
+    Participation,
+    ParticipationId,
+    PermissionDeniedError,
+    Snapshot,
+    SnapshotId,
+    SnapshotStatus,
+    SodiumEncryptionScheme,
+)
+
+
+def small_aggregation(recipient, recipient_key) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="foo",
+        vector_dimension=4,
+        modulus=13,
+        recipient=recipient,
+        recipient_key=recipient_key,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=13),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+def fake_participation(participant_id, agg_id, clerks, pi):
+    return Participation(
+        id=ParticipationId.random(),
+        participant=participant_id,
+        aggregation=agg_id,
+        recipient_encryption=None,
+        clerk_encryptions=[
+            (c.id, Encryption(Binary(bytes([ci, pi])))) for ci, c in enumerate(clerks)
+        ],
+    )
+
+
+def test_full_mocked_loop():
+    with with_service() as ctx:
+        agents = [new_full_agent(ctx.service) for _ in range(20)]
+        alice, alice_key = agents[0]
+        agg = small_aggregation(alice.id, alice_key.body.id)
+        ctx.service.create_aggregation(alice, agg)
+
+        candidates = ctx.service.suggest_committee(alice, agg.id)
+        assert len(candidates) == len(agents)
+
+        clerks = candidates[: agg.committee_sharing_scheme.output_size]
+        committee = Committee(
+            aggregation=agg.id, clerks_and_keys=[(c.id, c.keys[0]) for c in clerks]
+        )
+        ctx.service.create_committee(alice, committee)
+        assert ctx.service.get_committee(alice, agg.id) == committee
+
+        participants = [new_full_agent(ctx.service) for _ in range(100)]
+        for pi, (p, _) in enumerate(participants):
+            ctx.service.create_participation(
+                p, fake_participation(p.id, agg.id, clerks, pi)
+            )
+
+        status = ctx.service.get_aggregation_status(alice, agg.id)
+        assert status.number_of_participations == len(participants)
+        assert status.snapshots == []
+
+        snapshot = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+        ctx.service.create_snapshot(alice, snapshot)
+
+        status = ctx.service.get_aggregation_status(alice, agg.id)
+        assert status.snapshots == [
+            SnapshotStatus(id=snapshot.id, number_of_clerking_results=0, result_ready=False)
+        ]
+
+        # each clerk's job carries exactly its own column of the transpose
+        agent_by_id = {a.id: a for a, _ in agents}
+        for ci, c in enumerate(clerks):
+            agent = agent_by_id[c.id]
+            job = ctx.service.get_clerking_job(agent, c.id)
+            assert job.snapshot == snapshot.id
+            assert len(job.encryptions) == len(participants)
+            for enc in job.encryptions:
+                assert bytes(enc.inner)[0] == ci
+            ctx.service.create_clerking_result(
+                agent,
+                ClerkingResult(
+                    job=job.id, clerk=c.id, encryption=Encryption(Binary(bytes([ci])))
+                ),
+            )
+
+        status = ctx.service.get_aggregation_status(alice, agg.id)
+        assert status.snapshots == [
+            SnapshotStatus(
+                id=snapshot.id,
+                number_of_clerking_results=len(clerks),
+                result_ready=True,
+            )
+        ]
+
+        # queues drained
+        for c in clerks:
+            assert ctx.service.get_clerking_job(agent_by_id[c.id], c.id) is None
+
+        final = ctx.service.get_snapshot_result(alice, agg.id, snapshot.id)
+        assert len(final.clerk_encryptions) == 3
+        for ci, c in enumerate(clerks):
+            enc = next(r for r in final.clerk_encryptions if r.clerk == c.id)
+            assert bytes(enc.encryption.inner) == bytes([ci])
+
+
+def _mocked_ready_aggregation(ctx, n_clerks=3, n_participants=4):
+    agents = [new_full_agent(ctx.service) for _ in range(n_clerks + 1)]
+    alice, alice_key = agents[0]
+    agg = small_aggregation(alice.id, alice_key.body.id)
+    ctx.service.create_aggregation(alice, agg)
+    clerks = ctx.service.suggest_committee(alice, agg.id)[:n_clerks]
+    committee = Committee(
+        aggregation=agg.id, clerks_and_keys=[(c.id, c.keys[0]) for c in clerks]
+    )
+    ctx.service.create_committee(alice, committee)
+    participants = [new_full_agent(ctx.service) for _ in range(n_participants)]
+    for pi, (p, _) in enumerate(participants):
+        ctx.service.create_participation(p, fake_participation(p.id, agg.id, clerks, pi))
+    return agents, alice, agg, clerks
+
+
+def test_snapshot_retry_is_idempotent():
+    with with_service() as ctx:
+        agents, alice, agg, clerks = _mocked_ready_aggregation(ctx)
+        snapshot = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+        ctx.service.create_snapshot(alice, snapshot)
+        ctx.service.create_snapshot(alice, snapshot)  # retry: no-op
+        agent_by_id = {a.id: a for a, _ in agents}
+        for c in clerks:
+            agent = agent_by_id[c.id]
+            job = ctx.service.get_clerking_job(agent, c.id)
+            ctx.service.create_clerking_result(
+                agent,
+                ClerkingResult(job=job.id, clerk=c.id, encryption=Encryption(Binary(b"x"))),
+            )
+            # no second job was enqueued by the retry
+            assert ctx.service.get_clerking_job(agent, c.id) is None
+        status = ctx.service.get_aggregation_status(alice, agg.id)
+        assert status.snapshots[0].number_of_clerking_results == len(clerks)
+
+
+def test_participation_must_match_committee():
+    with with_service() as ctx:
+        agents, alice, agg, clerks = _mocked_ready_aggregation(ctx, n_participants=0)
+        p, _ = new_full_agent(ctx.service)
+        # too many clerk encryptions
+        bad = fake_participation(p.id, agg.id, clerks, 0)
+        bad.clerk_encryptions.append((clerks[0].id, Encryption(Binary(b"zz"))))
+        with pytest.raises(InvalidRequestError):
+            ctx.service.create_participation(p, bad)
+        # misordered clerks
+        bad = fake_participation(p.id, agg.id, list(reversed(clerks)), 0)
+        with pytest.raises(InvalidRequestError):
+            ctx.service.create_participation(p, bad)
+
+
+def test_snapshot_spoofing_denied():
+    with with_service() as ctx:
+        _, alice, agg_a, clerks_a = _mocked_ready_aggregation(ctx)
+        snap_a = Snapshot(id=SnapshotId.random(), aggregation=agg_a.id)
+        ctx.service.create_snapshot(alice, snap_a)
+
+        # bob owns aggregation B and tries to read A's snapshot through it
+        bob, bob_key = new_full_agent(ctx.service)
+        agg_b = small_aggregation(bob.id, bob_key.body.id)
+        ctx.service.create_aggregation(bob, agg_b)
+        assert ctx.service.get_snapshot_result(bob, agg_b.id, snap_a.id) is None
+        # and a non-recipient cannot query A at all
+        with pytest.raises(PermissionDeniedError):
+            ctx.service.get_snapshot_result(bob, agg_a.id, snap_a.id)
+        # bogus snapshot id on the right aggregation: None, not a fabricated result
+        assert ctx.service.get_snapshot_result(alice, agg_a.id, SnapshotId.random()) is None
