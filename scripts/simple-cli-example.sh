@@ -1,0 +1,58 @@
+#!/bin/sh
+# CLI acceptance walkthrough: mirror of the reference's
+# docs/simple-cli-example.sh. Expected final line: "result: 0 2 2 4 4 6 6 8 8 10"
+
+set -e
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO"
+DATA="${TMPDIR:-/tmp}/sda-simple-data-$$"
+PORT="${SDA_PORT:-18861}"
+SDA="python -m sda_tpu.cli.sda -s http://127.0.0.1:$PORT"
+
+rm -rf "$DATA"
+mkdir -p "$DATA"
+
+# start server in background
+python -m sda_tpu.cli.sdad --file "$DATA/server" httpd -b 127.0.0.1:$PORT &
+SDAD_PID=$!
+trap 'kill $SDAD_PID 2>/dev/null || true; rm -rf "$DATA"' EXIT
+for i in $(seq 50); do
+    if $SDA -i "$DATA/agent/probe" ping 2>/dev/null; then break; fi
+    sleep 0.1
+done
+
+# create recipient, plus three clerks, all with encryption keys
+for i in recipient clerk-1 clerk-2 clerk-3; do
+    $SDA -i "$DATA/agent/$i" agent create
+    $SDA -i "$DATA/agent/$i" agent keys create
+done
+
+# create participants; they don't need encryption keys
+for i in part-1 part-2 part-3; do
+    $SDA -i "$DATA/agent/$i" agent create
+done
+
+RECIPIENT="$SDA -i $DATA/agent/recipient"
+AGGID=ad3142d8-9a83-4f40-a64a-a8c90b701bde
+RECIPIENT_KEY_ID=$(grep -l '"ek"' "$DATA"/agent/recipient/keys/*.json | sed 's/.*\///;s/\.json//')
+
+# create aggregation, and open it (electing the clerk committee)
+$RECIPIENT aggregations create --id $AGGID "aggro" 10 433 "$RECIPIENT_KEY_ID" 3
+$RECIPIENT aggregations begin $AGGID
+
+# participants... participate
+$SDA -i "$DATA/agent/part-1" participate $AGGID 0 1 2 3 4 5 6 7 8 9
+$SDA -i "$DATA/agent/part-2" participate $AGGID 0 0 0 0 0 0 0 0 0 0
+$SDA -i "$DATA/agent/part-3" participate $AGGID 0 1 0 1 0 1 0 1 0 1
+
+# close the aggregation
+$RECIPIENT aggregations end $AGGID
+
+# have all potential clerks try and clerk
+for i in recipient clerk-1 clerk-2 clerk-3; do
+    $SDA -i "$DATA/agent/$i" clerk --once
+done
+
+# reconstruct the result
+$RECIPIENT aggregations reveal $AGGID

@@ -1,0 +1,1 @@
+"""sda_tpu.cli — the ``sda`` agent CLI and ``sdad`` server daemon."""

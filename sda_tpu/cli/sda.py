@@ -1,0 +1,230 @@
+"""``sda`` — the agent command line.
+
+Subcommand parity with /root/reference/cli/src/main.rs:29-81: ``ping``,
+``agent create/show``, ``agent keys create/show``, ``clerk [--once]``,
+``aggregations create/begin/end/reveal``, ``participate``. Identity lives in
+a directory (default ``.sda``; keys under ``keys/``), the server defaults to
+``http://localhost:8888``.
+
+One deliberate capability upgrade: ``--sharing shamir`` works here (the
+reference CLI panics ``unimplemented!()`` at cli/src/main.rs:226) — packed
+Shamir parameters are generated on the fly from ``--secret-count`` /
+``--privacy-threshold`` and the requested modulus size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+from pathlib import Path
+
+from ..client import SdaClient
+from ..crypto import Keystore, Filebased
+from ..protocol import (
+    Aggregation,
+    AggregationId,
+    Agent,
+    ChaChaMasking,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    AdditiveSharing,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+from ..rest import SdaHttpClient, TokenStore
+
+log = logging.getLogger("sda.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="sda", description="SDA agent CLI")
+    parser.add_argument("-s", "--server", default="http://localhost:8888", help="Server root")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    parser.add_argument(
+        "-i", "--identity", default=".sda", help="Storage directory for identity and keys"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("ping", help="check service availability")
+
+    agent = sub.add_parser("agent", help="identity management")
+    agent_sub = agent.add_subparsers(dest="agent_command", required=True)
+    agent_sub.add_parser("show")
+    create = agent_sub.add_parser("create")
+    create.add_argument("-f", "--force", action="store_true", help="Overwrite any existing identity")
+    keys = agent_sub.add_parser("keys")
+    keys_sub = keys.add_subparsers(dest="keys_command", required=True)
+    keys_sub.add_parser("create")
+    keys_sub.add_parser("show")
+
+    clerk = sub.add_parser("clerk", help="run a clerk in a loop")
+    clerk.add_argument("-o", "--once", action="store_true", help="Run just once and leave")
+    clerk.add_argument(
+        "--poll-seconds", type=float, default=300.0, help="Sleep between queue polls"
+    )
+
+    aggs = sub.add_parser(
+        "aggregations", aliases=["agg", "aggs", "aggregation"], help="manage aggregations"
+    )
+    aggs_sub = aggs.add_subparsers(dest="agg_command", required=True)
+    create = aggs_sub.add_parser("create")
+    create.add_argument("title")
+    create.add_argument("dimension", type=int)
+    create.add_argument("modulus", type=int)
+    create.add_argument("key", help="key to use for recipient encryption")
+    create.add_argument("share_count", type=int)
+    create.add_argument("--id")
+    create.add_argument("--mask", choices=["none", "full", "chacha"], default="none")
+    create.add_argument("--sharing", choices=["add", "shamir"], default="add")
+    create.add_argument("--secret-count", type=int, help="shamir: secrets packed per batch")
+    create.add_argument("--privacy-threshold", type=int, help="shamir: collusion tolerance")
+    for name in ("begin", "end", "reveal"):
+        p = aggs_sub.add_parser(name)
+        p.add_argument("aggregation_id")
+
+    part = sub.add_parser("participate", help="contribute a vector to an aggregation")
+    part.add_argument("id", help="aggregation id")
+    part.add_argument("values", nargs="+", type=int)
+
+    return parser
+
+
+def make_client(args):
+    identity = Path(args.identity)
+    service = SdaHttpClient(args.server, TokenStore(identity))
+    identitystore = Filebased(identity)
+    keystore = Keystore(identity / "keys")
+    agent = identitystore.get_aliased("agent", Agent.from_json)
+    return service, identitystore, keystore, agent
+
+
+def require_agent(agent):
+    if agent is None:
+        raise SystemExit('Agent is needed. Maybe run "sda agent create" ?')
+    return agent
+
+
+def cmd_aggregations_create(client, args) -> None:
+    modulus = args.modulus
+    if args.sharing == "add":
+        sharing = AdditiveSharing(share_count=args.share_count, modulus=modulus)
+    else:
+        from ..ops import find_packed_parameters
+
+        k = 3 if args.secret_count is None else args.secret_count
+        t = (args.share_count - k - 1) if args.privacy_threshold is None else args.privacy_threshold
+        p, w2, w3 = find_packed_parameters(
+            k, t, args.share_count, min_modulus_bits=min(30, max(8, modulus.bit_length()))
+        )
+        if p != modulus:
+            log.warning("modulus %d unsuitable for packed Shamir; using prime %d", modulus, p)
+            modulus = p
+        sharing = PackedShamirSharing(k, args.share_count, t, p, w2, w3)
+    mask = {
+        "none": NoMasking(),
+        "full": FullMasking(modulus=modulus),
+        "chacha": ChaChaMasking(modulus=modulus, dimension=args.dimension, seed_bitsize=128),
+    }[args.mask]
+    agg = Aggregation(
+        id=AggregationId(args.id) if args.id else AggregationId.random(),
+        title=args.title,
+        vector_dimension=args.dimension,
+        modulus=modulus,
+        recipient=client.agent.id,
+        recipient_key=EncryptionKeyId(args.key),
+        masking_scheme=mask,
+        committee_sharing_scheme=sharing,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    client.upload_aggregation(agg)
+    print(f"aggregation created. id: {agg.id}")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    level = [logging.WARNING, logging.INFO, logging.DEBUG][min(args.verbose, 2)]
+    logging.basicConfig(level=level, stream=sys.stderr, format="%(asctime)s %(name)s %(message)s")
+
+    service, identitystore, keystore, agent = make_client(args)
+
+    if args.command == "ping":
+        pong = service.ping()
+        if not pong.running:
+            raise SystemExit("Service may not be running")
+        log.info("Service appears to be running")
+        return 0
+
+    if args.command == "agent":
+        if args.agent_command == "show":
+            if agent is None:
+                log.warning("No local agent found")
+            else:
+                print(f"Local agent is {agent.id}")
+            return 0
+        if args.agent_command == "create":
+            if agent is not None and not args.force:
+                log.warning("Using existing agent; use --force to create new")
+            else:
+                agent = SdaClient.new_agent(keystore)
+                identitystore.put_aliased("agent", agent)
+                log.info("Created new agent with id %s", agent.id)
+            SdaClient(agent, keystore, service).upload_agent()
+            return 0
+        if args.agent_command == "keys":
+            client = SdaClient(require_agent(agent), keystore, service)
+            if args.keys_command == "create":
+                key = client.new_encryption_key()
+                client.upload_encryption_key(key)
+                print(f"Created and uploaded key: {key}")
+                return 0
+            if args.keys_command == "show":
+                for key_id in sorted(
+                    f[: -len(".json")]
+                    for f in __import__("os").listdir(keystore.path)
+                    if f.endswith(".json")
+                ):
+                    print(key_id)
+                return 0
+
+    if args.command == "clerk":
+        client = SdaClient(require_agent(agent), keystore, service)
+        service.ping()
+        while True:
+            log.debug("Polling for clerking job")
+            client.run_chores(-1)
+            if args.once:
+                return 0
+            time.sleep(args.poll_seconds)
+
+    if args.command in ("aggregations", "agg", "aggs", "aggregation"):
+        client = SdaClient(require_agent(agent), keystore, service)
+        service.ping()
+        if args.agg_command == "create":
+            cmd_aggregations_create(client, args)
+            return 0
+        agg_id = AggregationId(args.aggregation_id)
+        if args.agg_command == "begin":
+            client.begin_aggregation(agg_id)
+            return 0
+        if args.agg_command == "end":
+            client.end_aggregation(agg_id)
+            return 0
+        if args.agg_command == "reveal":
+            output = client.reveal_aggregation(agg_id).positive()
+            print("result:", " ".join(str(v) for v in output.values))
+            return 0
+
+    if args.command == "participate":
+        client = SdaClient(require_agent(agent), keystore, service)
+        client.participate(args.values, AggregationId(args.id))
+        return 0
+
+    raise SystemExit(f"Unknown command {args.command}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+"""``sdad`` — the server daemon.
+
+Parity with /root/reference/server-cli/src/bin/sdad.rs: pick a storage
+backend (``--file root`` durable, ``--mem`` in-memory; the reference's
+equivalents are ``--jfs``/``--mongo``), then ``httpd -b ip:port`` (default
+127.0.0.1:8888).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from ..rest import serve_forever
+from ..server import new_file_server, new_mem_server
+
+log = logging.getLogger("sda.sdad")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="sdad", description="SDA server daemon")
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    backend = parser.add_mutually_exclusive_group()
+    backend.add_argument("--file", metavar="ROOT", help="durable JSON-file store root")
+    backend.add_argument("--mem", action="store_true", help="in-memory store (dev)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    httpd = sub.add_parser("httpd", help="run the REST server")
+    httpd.add_argument("-b", "--bind", default="127.0.0.1:8888", metavar="IP:PORT")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    level = [logging.INFO, logging.DEBUG][min(args.verbose, 1)]
+    logging.basicConfig(level=level, stream=sys.stderr, format="%(asctime)s %(name)s %(message)s")
+
+    if args.file:
+        service = new_file_server(args.file)
+        log.info("using file store at %s", args.file)
+    else:
+        service = new_mem_server()
+        log.info("using in-memory store")
+
+    host, _, port = args.bind.rpartition(":")
+    serve_forever((host or "127.0.0.1", int(port)), service)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,14 +3,15 @@
 Mirrors the reference's jfs-backed ``Filebased`` store (client-store/src/
 file.rs): one JSON file per object under a directory, plus alias indirection
 (``alias -> id -> object``, store.rs:11-40) used by the CLI to remember "the
-agent identity in this directory".
+agent identity in this directory". Built on the shared atomic JsonDir
+(private 0600/0700 permissions — these files hold secret keys).
 """
 
 from __future__ import annotations
 
-import json
-import os
 from dataclasses import dataclass
+
+from ..utils.jsondir import JsonDir
 
 from ..protocol import B32, B64
 from ..protocol.schemes import EncryptionKey, SigningKey, VerificationKey, _untag
@@ -69,26 +70,16 @@ class Filebased:
     """One JSON file per object; safe for ids and aliases used here."""
 
     def __init__(self, path):
-        self.path = str(path)
-        os.makedirs(self.path, exist_ok=True)
-
-    def _file(self, id: str) -> str:
-        if "/" in id or id.startswith("."):
-            raise ValueError(f"bad store id {id!r}")
-        return os.path.join(self.path, f"{id}.json")
+        self._dir = JsonDir(path)
+        self.path = self._dir.path
 
     def put(self, id: str, obj) -> None:
         payload = obj.to_json() if hasattr(obj, "to_json") else obj
-        tmp = self._file(id) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self._file(id))
+        self._dir.put(id, payload)
 
     def get(self, id: str, from_json=None):
-        try:
-            with open(self._file(id)) as f:
-                payload = json.load(f)
-        except FileNotFoundError:
+        payload = self._dir.get(id)
+        if payload is None:
             return None
         return from_json(payload) if from_json else payload
 

@@ -20,6 +20,11 @@ from __future__ import annotations
 
 import numpy as np
 
+# The int64 plane is exact only for moduli below 2**31 (products < 2**62,
+# sums of < 2**32 reduced terms). Aggregation creation enforces this bound;
+# the limb-decomposed kernels will lift it to 61-bit moduli.
+MAX_SAFE_MODULUS = 1 << 31
+
 
 def rust_rem_np(x, m):
     """Truncated remainder (Rust ``%``) for numpy arrays / scalars."""

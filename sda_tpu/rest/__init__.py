@@ -1,0 +1,14 @@
+"""sda_tpu.rest — the HTTP binding of the service seam (server + client)."""
+
+from .client import SdaHttpClient
+from .server import listen, make_handler, serve_background, serve_forever
+from .tokenstore import TokenStore
+
+__all__ = [
+    "SdaHttpClient",
+    "TokenStore",
+    "listen",
+    "make_handler",
+    "serve_background",
+    "serve_forever",
+]

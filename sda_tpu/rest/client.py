@@ -1,0 +1,180 @@
+"""REST binding of the SDA service — the client proxy.
+
+Re-implements the full ``SdaService`` interface over HTTP (reference:
+client-http/src/client.rs:173-370), decorating every authenticated request
+with Basic auth from the ``TokenStore``. Response protocol: 404 with the
+``Resource-not-found`` header means ``None``; 401/403/400 map back to the
+protocol error types.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+from urllib.parse import quote, urlencode
+
+import requests
+
+from ..protocol import (
+    Agent,
+    Aggregation,
+    AggregationId,
+    AggregationStatus,
+    ClerkCandidate,
+    ClerkingJob,
+    Committee,
+    InvalidCredentialsError,
+    InvalidRequestError,
+    PermissionDeniedError,
+    Pong,
+    SdaError,
+    SdaService,
+    SnapshotResult,
+    signed_encryption_key_from_json,
+)
+
+
+class SdaHttpClient(SdaService):
+    def __init__(self, server_root: str, token_store):
+        self.server_root = server_root.rstrip("/")
+        self.token_store = token_store
+        self.session = requests.Session()
+        self.session.headers["User-Agent"] = "sda-tpu client"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _request(self, method: str, path: str, caller=None, body=None, params=None):
+        url = self.server_root + path
+        if params:
+            url += "?" + urlencode(params)
+        auth = (str(caller.id), self.token_store.get()) if caller is not None else None
+        data = None
+        headers = {}
+        if body is not None:
+            payload = body.to_json() if hasattr(body, "to_json") else body
+            data = json.dumps(payload).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        resp = self.session.request(method, url, data=data, auth=auth, headers=headers)
+        return self._process(resp)
+
+    @staticmethod
+    def _process(resp) -> Optional[dict]:
+        if resp.status_code in (200, 201):
+            return resp.json() if resp.content else None
+        if resp.status_code == 404:
+            if "Resource-not-found" in resp.headers:
+                return None
+            raise SdaError("HTTP/REST route not found")
+        if resp.status_code == 401:
+            raise InvalidCredentialsError(resp.text)
+        if resp.status_code == 403:
+            raise PermissionDeniedError(resp.text)
+        if resp.status_code == 400:
+            raise InvalidRequestError(resp.text)
+        raise SdaError(f"HTTP/REST error: {resp.status_code} {resp.text}")
+
+    # -- base ---------------------------------------------------------------
+
+    def ping(self) -> Pong:
+        return Pong.from_json(self._request("GET", "/v1/ping"))
+
+    # -- agents -------------------------------------------------------------
+
+    def create_agent(self, caller, agent) -> None:
+        self._request("POST", "/v1/agents/me", caller, agent)
+
+    def get_agent(self, caller, agent_id):
+        obj = self._request("GET", f"/v1/agents/{quote(str(agent_id))}", caller)
+        return None if obj is None else Agent.from_json(obj)
+
+    def upsert_profile(self, caller, profile) -> None:
+        self._request("POST", "/v1/agents/me/profile", caller, profile)
+
+    def get_profile(self, caller, owner_id):
+        from ..protocol import Profile
+
+        obj = self._request("GET", f"/v1/agents/{quote(str(owner_id))}/profile", caller)
+        return None if obj is None else Profile.from_json(obj)
+
+    def create_encryption_key(self, caller, signed_key) -> None:
+        self._request("POST", "/v1/agents/me/keys", caller, signed_key)
+
+    def get_encryption_key(self, caller, key_id):
+        obj = self._request("GET", f"/v1/agents/any/keys/{quote(str(key_id))}", caller)
+        return None if obj is None else signed_encryption_key_from_json(obj)
+
+    # -- aggregations -------------------------------------------------------
+
+    def list_aggregations(self, caller, filter=None, recipient=None):
+        params = {}
+        if filter is not None:
+            params["title"] = filter
+        if recipient is not None:
+            params["recipient"] = str(recipient)
+        obj = self._request("GET", "/v1/aggregations", caller, params=params)
+        return [AggregationId(i) for i in obj]
+
+    def get_aggregation(self, caller, aggregation_id):
+        obj = self._request("GET", f"/v1/aggregations/{quote(str(aggregation_id))}", caller)
+        return None if obj is None else Aggregation.from_json(obj)
+
+    def get_committee(self, caller, aggregation_id):
+        obj = self._request(
+            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/committee", caller
+        )
+        return None if obj is None else Committee.from_json(obj)
+
+    # -- recipient ----------------------------------------------------------
+
+    def create_aggregation(self, caller, aggregation) -> None:
+        self._request("POST", "/v1/aggregations", caller, aggregation)
+
+    def delete_aggregation(self, caller, aggregation_id) -> None:
+        self._request("DELETE", f"/v1/aggregations/{quote(str(aggregation_id))}", caller)
+
+    def suggest_committee(self, caller, aggregation_id):
+        obj = self._request(
+            "GET",
+            f"/v1/aggregations/{quote(str(aggregation_id))}/committee/suggestions",
+            caller,
+        )
+        return [ClerkCandidate.from_json(c) for c in obj]
+
+    def create_committee(self, caller, committee) -> None:
+        self._request("POST", "/v1/aggregations/implied/committee", caller, committee)
+
+    def get_aggregation_status(self, caller, aggregation_id):
+        obj = self._request(
+            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/status", caller
+        )
+        return None if obj is None else AggregationStatus.from_json(obj)
+
+    def create_snapshot(self, caller, snapshot) -> None:
+        self._request("POST", "/v1/aggregations/implied/snapshot", caller, snapshot)
+
+    def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
+        obj = self._request(
+            "GET",
+            f"/v1/aggregations/{quote(str(aggregation_id))}/snapshots/{quote(str(snapshot_id))}/result",
+            caller,
+        )
+        return None if obj is None else SnapshotResult.from_json(obj)
+
+    # -- participation ------------------------------------------------------
+
+    def create_participation(self, caller, participation) -> None:
+        self._request("POST", "/v1/aggregations/participations", caller, participation)
+
+    # -- clerking -----------------------------------------------------------
+
+    def get_clerking_job(self, caller, clerk_id):
+        obj = self._request("GET", "/v1/aggregations/any/jobs", caller)
+        return None if obj is None else ClerkingJob.from_json(obj)
+
+    def create_clerking_result(self, caller, result) -> None:
+        self._request(
+            "POST",
+            f"/v1/aggregations/implied/jobs/{quote(str(result.job))}/result",
+            caller,
+            result,
+        )

@@ -1,0 +1,326 @@
+"""REST binding of the SDA service — the server side.
+
+Route table, auth model, and status-code mapping are wire-compatible with
+the reference's rouille binding (/root/reference/server-http/src/lib.rs):
+
+    GET    /v1/ping
+    GET    /v1/agents/{AgentId}
+    POST   /v1/agents/me
+    GET    /v1/agents/{AgentId}/profile
+    POST   /v1/agents/me/profile
+    GET    /v1/agents/any/keys/{EncryptionKeyId}
+    POST   /v1/agents/me/keys
+    POST   /v1/aggregations
+    GET    /v1/aggregations?title=&recipient=
+    GET    /v1/aggregations/{AggregationId}
+    DELETE /v1/aggregations/{AggregationId}
+    GET    /v1/aggregations/{AggregationId}/committee/suggestions
+    POST   /v1/aggregations/implied/committee
+    GET    /v1/aggregations/{AggregationId}/committee
+    POST   /v1/aggregations/participations
+    GET    /v1/aggregations/{AggregationId}/status
+    POST   /v1/aggregations/implied/snapshot
+    GET    /v1/aggregations/any/jobs
+    POST   /v1/aggregations/implied/jobs/{ClerkingJobId}/result
+    GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result
+
+Auth: HTTP Basic, username = AgentId, password = token recorded on first
+``create_agent`` (trust-on-first-use, lib.rs:298-315). Missing resources are
+404 with a ``Resource-not-found: true`` header so clients can distinguish
+"no resource" from "no route" (lib.rs:338-343). Errors map to
+401 / 403 / 400 / 500 (lib.rs:112-117).
+
+Built on the stdlib ThreadingHTTPServer: one import, zero deps, adequate for
+a coordination plane whose heavy payloads are bulk base64 blobs (the math
+plane never crosses this boundary per element).
+"""
+
+from __future__ import annotations
+
+import base64
+import contextlib
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..protocol import (
+    Agent,
+    AgentId,
+    Aggregation,
+    AggregationId,
+    ClerkingResult,
+    Committee,
+    EncryptionKeyId,
+    InvalidCredentialsError,
+    InvalidRequestError,
+    Labelled,
+    Participation,
+    PermissionDeniedError,
+    Profile,
+    SdaError,
+    Snapshot,
+    SnapshotId,
+    signed_encryption_key_from_json,
+)
+
+log = logging.getLogger("sda.rest.server")
+
+_UUID = r"[0-9a-fA-F-]{36}"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    service = None  # SdaServerService, set by make_handler
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):
+        log.debug("%s " + fmt, self.address_string(), *args)
+
+    def _auth_token(self):
+        header = (self.headers.get("Authorization") or "").strip()
+        if not header.startswith("Basic "):
+            raise InvalidCredentialsError("Basic Authorization required")
+        try:
+            decoded = base64.b64decode(header[len("Basic ") :]).decode("utf-8")
+            username, _, password = decoded.partition(":")
+            return Labelled(AgentId(username), password)
+        except (ValueError, UnicodeDecodeError):
+            raise InvalidCredentialsError("Invalid Auth header")
+
+    def _caller(self) -> Agent:
+        return self.service.server.check_auth_token(self._auth_token())
+
+    def _read_json(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            raise InvalidRequestError("Expected a body")
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as e:
+            raise InvalidRequestError(f"malformed JSON body: {e}")
+
+    def _read(self, from_json):
+        """Read + decode the request body; malformed payloads are 400s
+        (the reference maps these to 500 via its catch-all; fixed here)."""
+        payload = self._read_json()
+        try:
+            return from_json(payload)
+        except InvalidRequestError:
+            raise
+        except Exception as e:
+            raise InvalidRequestError(f"malformed body: {e}")
+
+    def _send(self, status: int, body: bytes = b"", headers=()):
+        self.send_response(status)
+        for k, v in headers:
+            self.send_header(k, v)
+        if body:
+            self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json_option(self, obj):
+        if obj is None:
+            self._send(404, headers=[("Resource-not-found", "true")])
+        else:
+            payload = obj.to_json() if hasattr(obj, "to_json") else obj
+            self._send(200, json.dumps(payload).encode("utf-8"))
+
+    def _dispatch(self, method: str):
+        path, _, query = self.path.partition("?")
+        params = {}
+        for pair in query.split("&"):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                from urllib.parse import unquote_plus
+
+                params[k] = unquote_plus(v)
+        try:
+            handled = self._route(method, path, params)
+            if not handled:
+                log.error("route not found: %s %s", method, path)
+                self._send(404)
+        except InvalidCredentialsError as e:
+            self._send(401, str(e).encode())
+        except PermissionDeniedError as e:
+            self._send(403, str(e).encode())
+        except InvalidRequestError as e:
+            self._send(400, str(e).encode())
+        except Exception as e:  # ServerError and unexpected -> 500
+            log.error("%s %s -> 500: %s", method, path, e)
+            self._send(500, str(e).encode())
+
+    # -- routes -------------------------------------------------------------
+
+    def _route(self, method, path, params) -> bool:
+        m = lambda pat: re.fullmatch(pat, path)
+        svc = self.service
+
+        if method == "GET" and path == "/v1/ping":
+            self._send_json_option(svc.ping())
+            return True
+
+        if method == "POST" and path == "/v1/agents/me":
+            # TOFU: token recorded on successful agent creation (lib.rs:192-201)
+            token = self._auth_token()
+            agent = self._read(Agent.from_json)
+            if agent.id != token.id:
+                self._send(400, b"inconsistent agent ids")
+                return True
+            svc.server.register_auth_token(token)
+            svc.create_agent(agent, agent)
+            self._send(201)
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/agents/({_UUID})")):
+            self._send_json_option(svc.get_agent(self._caller(), AgentId(match.group(1))))
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/agents/({_UUID})/profile")):
+            self._send_json_option(svc.get_profile(self._caller(), AgentId(match.group(1))))
+            return True
+
+        if method == "POST" and path == "/v1/agents/me/profile":
+            svc.upsert_profile(self._caller(), self._read(Profile.from_json))
+            self._send(201)
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/agents/any/keys/({_UUID})")):
+            self._send_json_option(
+                svc.get_encryption_key(self._caller(), EncryptionKeyId(match.group(1)))
+            )
+            return True
+
+        if method == "POST" and path == "/v1/agents/me/keys":
+            svc.create_encryption_key(
+                self._caller(), self._read(signed_encryption_key_from_json)
+            )
+            self._send(201)
+            return True
+
+        if method == "POST" and path == "/v1/aggregations":
+            svc.create_aggregation(self._caller(), self._read(Aggregation.from_json))
+            self._send(201)
+            return True
+
+        if method == "GET" and path == "/v1/aggregations":
+            recipient = params.get("recipient")
+            ids = svc.list_aggregations(
+                self._caller(),
+                params.get("title"),
+                AgentId(recipient) if recipient else None,
+            )
+            self._send_json_option([str(i) for i in ids])
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/aggregations/({_UUID})/committee/suggestions")):
+            out = svc.suggest_committee(self._caller(), AggregationId(match.group(1)))
+            self._send_json_option([c.to_json() for c in out])
+            return True
+
+        if method == "POST" and path == "/v1/aggregations/implied/committee":
+            svc.create_committee(self._caller(), self._read(Committee.from_json))
+            self._send(201)
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/aggregations/({_UUID})/committee")):
+            self._send_json_option(
+                svc.get_committee(self._caller(), AggregationId(match.group(1)))
+            )
+            return True
+
+        if method == "POST" and path == "/v1/aggregations/participations":
+            svc.create_participation(
+                self._caller(), self._read(Participation.from_json)
+            )
+            self._send(201)
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/aggregations/({_UUID})/status")):
+            self._send_json_option(
+                svc.get_aggregation_status(self._caller(), AggregationId(match.group(1)))
+            )
+            return True
+
+        if method == "POST" and path == "/v1/aggregations/implied/snapshot":
+            svc.create_snapshot(self._caller(), self._read(Snapshot.from_json))
+            self._send(201)
+            return True
+
+        if method == "GET" and path == "/v1/aggregations/any/jobs":
+            caller = self._caller()
+            self._send_json_option(svc.get_clerking_job(caller, caller.id))
+            return True
+
+        if method == "POST" and (match := m(rf"/v1/aggregations/implied/jobs/({_UUID})/result")):
+            svc.create_clerking_result(
+                self._caller(), self._read(ClerkingResult.from_json)
+            )
+            self._send(201)
+            return True
+
+        if method == "GET" and (
+            match := m(rf"/v1/aggregations/({_UUID})/snapshots/({_UUID})/result")
+        ):
+            self._send_json_option(
+                svc.get_snapshot_result(
+                    self._caller(), AggregationId(match.group(1)), SnapshotId(match.group(2))
+                )
+            )
+            return True
+
+        if method == "GET" and (match := m(rf"/v1/aggregations/({_UUID})")):
+            self._send_json_option(
+                svc.get_aggregation(self._caller(), AggregationId(match.group(1)))
+            )
+            return True
+
+        if method == "DELETE" and (match := m(rf"/v1/aggregations/({_UUID})")):
+            svc.delete_aggregation(self._caller(), AggregationId(match.group(1)))
+            self._send(200)
+            return True
+
+        return False
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+def make_handler(service):
+    return type("SdaHandler", (_Handler,), {"service": service})
+
+
+def listen(addr: tuple, service) -> ThreadingHTTPServer:
+    """Create (but do not start) an HTTP server bound to addr."""
+    return ThreadingHTTPServer(addr, make_handler(service))
+
+
+def serve_forever(addr: tuple, service) -> None:
+    httpd = listen(addr, service)
+    log.info("sda REST server listening on %s:%s", *addr)
+    httpd.serve_forever()
+
+
+@contextlib.contextmanager
+def serve_background(service, host: str = "127.0.0.1", port: int = 0):
+    """Run the REST server on a daemon thread; yields the base URL."""
+    httpd = listen((host, port), service)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://{httpd.server_address[0]}:{httpd.server_address[1]}"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=5)

@@ -41,52 +41,16 @@ from ..protocol.ids import (
     ParticipationId,
     SnapshotId,
 )
+from ..utils.jsondir import ConflictError, JsonDir
 from .stores import AggregationsStore, AgentsStore, AuthTokensStore, ClerkingJobsStore
 
 
-class JsonDir:
-    """A directory of ``<id>.json`` files with atomic writes."""
-
-    def __init__(self, path: str):
-        self.path = path
-        os.makedirs(path, exist_ok=True)
-
-    def _file(self, id) -> str:
-        name = str(id)
-        if "/" in name or name.startswith("."):
-            raise ValueError(f"bad id {name!r}")
-        return os.path.join(self.path, name + ".json")
-
-    def put(self, id, payload) -> None:
-        tmp = self._file(id) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self._file(id))
-
-    def get(self, id):
-        try:
-            with open(self._file(id)) as f:
-                return json.load(f)
-        except FileNotFoundError:
-            return None
-
-    def create(self, id, payload) -> None:
-        """create-if-identical: reposting identical content is a no-op."""
-        existing = self.get(id)
-        if existing is not None and existing != payload:
-            raise ServerError(f"object already exists: {id}")
-        self.put(id, payload)
-
-    def delete(self, id) -> None:
-        try:
-            os.remove(self._file(id))
-        except FileNotFoundError:
-            pass
-
-    def list_ids(self) -> list:
-        return sorted(
-            f[: -len(".json")] for f in os.listdir(self.path) if f.endswith(".json")
-        )
+def _create(jdir: JsonDir, id, payload) -> None:
+    """create-if-identical, mapped onto the server error type."""
+    try:
+        jdir.create(id, payload)
+    except ConflictError as e:
+        raise ServerError(str(e))
 
 
 class FileAuthTokensStore(AuthTokensStore):
@@ -114,7 +78,7 @@ class FileAgentsStore(AgentsStore):
         self.keys = JsonDir(os.path.join(path, "keys"))
 
     def create_agent(self, agent) -> None:
-        self.agents.create(agent.id, agent.to_json())
+        _create(self.agents, agent.id, agent.to_json())
 
     def get_agent(self, agent_id):
         payload = self.agents.get(agent_id)
@@ -128,7 +92,7 @@ class FileAgentsStore(AgentsStore):
         return None if payload is None else Profile.from_json(payload)
 
     def create_encryption_key(self, signed_key) -> None:
-        self.keys.create(signed_key.body.id, signed_key.to_json())
+        _create(self.keys, signed_key.body.id, signed_key.to_json())
 
     def get_encryption_key(self, key_id):
         payload = self.keys.get(key_id)
@@ -172,7 +136,7 @@ class FileAggregationsStore(AggregationsStore):
         return out
 
     def create_aggregation(self, aggregation) -> None:
-        self.aggregations.create(aggregation.id, aggregation.to_json())
+        _create(self.aggregations, aggregation.id, aggregation.to_json())
 
     def get_aggregation(self, aggregation_id):
         payload = self.aggregations.get(aggregation_id)
@@ -195,17 +159,19 @@ class FileAggregationsStore(AggregationsStore):
         return None if payload is None else Committee.from_json(payload)
 
     def create_committee(self, committee) -> None:
-        self.committees.create(committee.aggregation, committee.to_json())
+        _create(self.committees, committee.aggregation, committee.to_json())
 
     def create_participation(self, participation) -> None:
         if self.aggregations.get(participation.aggregation) is None:
             raise InvalidRequestError(f"no aggregation {participation.aggregation}")
-        self._participations(participation.aggregation).create(
-            participation.id, participation.to_json()
+        _create(
+            self._participations(participation.aggregation),
+            participation.id,
+            participation.to_json(),
         )
 
     def create_snapshot(self, snapshot) -> None:
-        self._snapshots(snapshot.aggregation).create(snapshot.id, snapshot.to_json())
+        _create(self._snapshots(snapshot.aggregation), snapshot.id, snapshot.to_json())
 
     def list_snapshots(self, aggregation_id) -> list:
         return [SnapshotId(s) for s in self._snapshots(aggregation_id).list_ids()]
@@ -218,8 +184,10 @@ class FileAggregationsStore(AggregationsStore):
         return len(self._participations(aggregation_id).list_ids())
 
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
+        # write-once: a retry after a partial snapshot must not re-freeze a
+        # different membership (participations may have arrived in between)
         members = self._participations(aggregation_id).list_ids()
-        self.members.put(snapshot_id, members)
+        self.members.create_once(snapshot_id, members)
 
     def iter_snapped_participations(self, aggregation_id, snapshot_id):
         members = self.members.get(snapshot_id) or []
@@ -253,7 +221,11 @@ class FileClerkingJobsStore(ClerkingJobsStore):
         return JsonDir(os.path.join(self.root, "results", str(snapshot_id)))
 
     def enqueue_clerking_job(self, job) -> None:
-        self._queue(job.clerk).create(job.id, job.to_json())
+        # idempotent under snapshot retries (job ids are deterministic): a
+        # job already queued or already completed is not enqueued again
+        if self._done(job.clerk).get(job.id) is not None:
+            return
+        _create(self._queue(job.clerk), job.id, job.to_json())
 
     def poll_clerking_job(self, clerk_id):
         queue = self._queue(clerk_id)

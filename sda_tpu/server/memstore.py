@@ -161,6 +161,9 @@ class MemAggregationsStore(AggregationsStore):
 
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         with self._lock:
+            # write-once: retries must not re-freeze a different membership
+            if snapshot_id in self._snapshot_members:
+                return
             members = list(self._participations.get(aggregation_id, {}).keys())
             self._snapshot_members[snapshot_id] = members
 
@@ -188,6 +191,9 @@ class MemClerkingJobsStore(ClerkingJobsStore):
 
     def enqueue_clerking_job(self, job) -> None:
         with self._lock:
+            # idempotent under snapshot retries (job ids are deterministic)
+            if job.id in self._jobs:
+                return
             self._jobs[job.id] = job
             self._queues.setdefault(job.clerk, []).append(job)
 

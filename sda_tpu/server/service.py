@@ -71,6 +71,14 @@ class SdaServer:
         return self.aggregation_store.get_committee(aggregation_id)
 
     def create_aggregation(self, aggregation) -> None:
+        from ..ops.modular import MAX_SAFE_MODULUS
+
+        if not 0 < aggregation.modulus < MAX_SAFE_MODULUS:
+            raise InvalidRequestError(
+                f"modulus {aggregation.modulus} outside (0, 2^31): the int64 "
+                "math plane guarantees exactness only below 2^31 (larger "
+                "moduli need the limb-decomposed kernels)"
+            )
         self.aggregation_store.create_aggregation(aggregation)
 
     def delete_aggregation(self, aggregation_id) -> None:
@@ -91,6 +99,11 @@ class SdaServer:
                 f"Expected {expected} clerks in the committee, "
                 f"found {len(committee.clerks_and_keys)} instead"
             )
+        # a clerk appearing twice would map two share columns onto one
+        # reconstruction index, making the aggregation unrevealable
+        clerk_ids = [c for (c, _) in committee.clerks_and_keys]
+        if len(set(clerk_ids)) != len(clerk_ids):
+            raise InvalidRequestError("committee contains duplicate clerks")
         self.aggregation_store.create_committee(committee)
 
     def create_participation(self, participation) -> None:
@@ -173,6 +186,17 @@ class SdaServer:
 
     def upsert_auth_token(self, token) -> None:
         self.auth_tokens_store.upsert_auth_token(token)
+
+    def register_auth_token(self, token) -> None:
+        """Trust-on-first-use registration: the first token presented for an
+        agent id sticks; later attempts with a different token are rejected
+        (otherwise anyone could re-post a public Agent object and hijack the
+        account by overwriting its token)."""
+        existing = self.auth_tokens_store.get_auth_token(token.id)
+        if existing is None:
+            self.auth_tokens_store.upsert_auth_token(token)
+        elif existing != token:
+            raise InvalidCredentialsError("agent already registered")
 
     def check_auth_token(self, token):
         stored = self.auth_tokens_store.get_auth_token(token.id)

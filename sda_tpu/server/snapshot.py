@@ -10,10 +10,21 @@ encryption into the snapshot mask blob.
 from __future__ import annotations
 
 import logging
+import uuid
 
 from ..protocol import ClerkingJob, ClerkingJobId, ServerError
 
 log = logging.getLogger("sda.server.snapshot")
+
+# Deterministic job ids: uuid5 of (snapshot, clerk position). A crashed
+# snapshot run retried by the client re-creates byte-identical jobs, which
+# the stores' create-if-identical semantics absorb — no duplicate jobs, no
+# double-counted results.
+_JOB_NAMESPACE = uuid.UUID("6b1b36cf-4f3a-4bca-8a3c-1d53437e8ed9")
+
+
+def _job_id(snapshot_id, clerk_index: int) -> ClerkingJobId:
+    return ClerkingJobId(uuid.uuid5(_JOB_NAMESPACE, f"{snapshot_id}:{clerk_index}"))
 
 
 def run_snapshot(server, snapshot) -> None:
@@ -41,18 +52,18 @@ def run_snapshot(server, snapshot) -> None:
     )
 
     log.debug("snapshot %s: enqueueing clerking jobs", snapshot.id)
-    for (clerk_id, _), encryptions in zip(committee.clerks_and_keys, per_clerk):
+    for ix, ((clerk_id, _), encryptions) in enumerate(
+        zip(committee.clerks_and_keys, per_clerk)
+    ):
         server.clerking_job_store.enqueue_clerking_job(
             ClerkingJob(
-                id=ClerkingJobId.random(),
+                id=_job_id(snapshot.id, ix),
                 clerk=clerk_id,
                 aggregation=snapshot.aggregation,
                 snapshot=snapshot.id,
                 encryptions=encryptions,
             )
         )
-
-    server.aggregation_store.create_snapshot(snapshot)
 
     if aggregation.masking_scheme.has_mask():
         log.debug("snapshot %s: collecting masking data", snapshot.id)
@@ -64,5 +75,11 @@ def run_snapshot(server, snapshot) -> None:
                 raise ServerError("participation should have had a recipient encryption")
             recipient_encryptions.append(part.recipient_encryption)
         server.aggregation_store.create_snapshot_mask(snapshot.id, recipient_encryptions)
+
+    # persisting the snapshot record is the COMMIT POINT: the retry guard
+    # above keys on it, so everything before this line must be (and is)
+    # idempotent — membership freeze is write-once, job ids deterministic,
+    # mask blob a plain overwrite of identical content.
+    server.aggregation_store.create_snapshot(snapshot)
 
     log.debug("snapshot %s: done", snapshot.id)

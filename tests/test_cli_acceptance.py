@@ -1,0 +1,23 @@
+"""CLI acceptance: run the walkthrough script end-to-end over a real server
+process and assert the documented expected output (reference:
+docs/simple-cli-example.sh, README.md:157)."""
+
+import os
+import pathlib
+import subprocess
+
+
+def test_simple_cli_example():
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    env["SDA_PORT"] = "18871"
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run(
+        ["sh", str(repo / "scripts" / "simple-cli-example.sh")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "result: 0 2 2 4 4 6 6 8 8 10" in proc.stdout, proc.stdout

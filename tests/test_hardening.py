@@ -1,0 +1,159 @@
+"""Regression tests for hardening fixes found in review: TOFU token
+takeover, duplicate committees, snapshot retry idempotence on the durable
+store, the int64 modulus bound, and key file permissions."""
+
+import os
+import stat
+
+import pytest
+import requests
+
+from sda_fixtures import new_client, new_full_agent, with_server
+from sda_tpu.crypto import Keystore
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    Committee,
+    InvalidRequestError,
+    NoMasking,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.rest import SdaHttpClient, TokenStore, serve_background
+from sda_tpu.server import new_file_server, new_mem_server
+
+
+def test_token_takeover_rejected(tmp_path):
+    server = new_mem_server()
+    with serve_background(server) as base_url:
+        service = SdaHttpClient(base_url, TokenStore(tmp_path / "victim"))
+        victim = new_client(tmp_path / "vic", service)
+        victim.upload_agent()
+
+        # attacker fetches the victim's public agent object and re-posts it
+        # with their own token
+        agent_json = server.get_agent(victim.agent, victim.agent.id).to_json()
+        resp = requests.post(
+            f"{base_url}/v1/agents/me",
+            json=agent_json,
+            auth=(str(victim.agent.id), "attacker-token"),
+        )
+        assert resp.status_code == 401
+        # the victim's original token still works
+        assert service.get_agent(victim.agent, victim.agent.id) is not None
+        # re-posting with the ORIGINAL token stays idempotent
+        resp = requests.post(
+            f"{base_url}/v1/agents/me",
+            json=agent_json,
+            auth=(str(victim.agent.id), TokenStore(tmp_path / "victim").get()),
+        )
+        assert resp.status_code == 201
+
+
+def test_duplicate_committee_rejected():
+    with with_server() as ctx:
+        alice, alice_key = new_full_agent(ctx.service)
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="x",
+            vector_dimension=4,
+            modulus=13,
+            recipient=alice.id,
+            recipient_key=alice_key.body.id,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=13),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        ctx.service.create_aggregation(alice, agg)
+        bob, bob_key = new_full_agent(ctx.service)
+        committee = Committee(
+            aggregation=agg.id,
+            clerks_and_keys=[
+                (bob.id, bob_key.body.id),
+                (bob.id, bob_key.body.id),
+                (alice.id, alice_key.body.id),
+            ],
+        )
+        with pytest.raises(InvalidRequestError, match="duplicate"):
+            ctx.service.create_committee(alice, committee)
+
+
+def test_modulus_bound_enforced():
+    with with_server() as ctx:
+        alice, alice_key = new_full_agent(ctx.service)
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="big",
+            vector_dimension=4,
+            modulus=1 << 40,
+            recipient=alice.id,
+            recipient_key=alice_key.body.id,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=1 << 40),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        with pytest.raises(InvalidRequestError, match="2\\^31"):
+            ctx.service.create_aggregation(alice, agg)
+
+
+def test_snapshot_retry_idempotent_on_file_store(tmp_path):
+    import numpy as np
+
+    from sda_tpu.protocol import Snapshot, SnapshotId
+
+    service = new_file_server(tmp_path / "server")
+    recipient = new_client(tmp_path / "recipient", service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="retry",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    clerks = [new_client(tmp_path / f"c{i}", service) for i in range(3)]
+    for c in clerks:
+        k = c.new_encryption_key()
+        c.upload_agent()
+        c.upload_encryption_key(k)
+    recipient.begin_aggregation(agg.id)
+    for i in range(2):
+        p = new_client(tmp_path / f"p{i}", service)
+        p.upload_agent()
+        p.participate([1, 2, 3, 4], agg.id)
+
+    snap = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    service.create_snapshot(recipient.agent, snap)
+    service.create_snapshot(recipient.agent, snap)  # client retry
+
+    members = {c for c, _ in service.get_committee(recipient.agent, agg.id).clerks_and_keys}
+    for c in [recipient] + clerks:
+        if c.agent.id in members:
+            c.run_chores(-1)
+    status = service.get_aggregation_status(recipient.agent, agg.id)
+    assert status.snapshots[0].number_of_clerking_results == 3  # not 6
+    out = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(out.positive().values, [2, 4, 6, 8])
+
+
+def test_keystore_files_are_private(tmp_path):
+    ks = Keystore(tmp_path / "keys")
+    from sda_tpu.crypto import CryptoModule
+
+    module = CryptoModule(ks)
+    module.new_encryption_key()
+    key_dir = tmp_path / "keys"
+    assert stat.S_IMODE(os.stat(key_dir).st_mode) == 0o700
+    for f in os.listdir(key_dir):
+        mode = stat.S_IMODE(os.stat(key_dir / f).st_mode)
+        assert mode == 0o600, f"{f} has mode {oct(mode)}"

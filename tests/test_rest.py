@@ -1,0 +1,109 @@
+"""REST binding tests that always run over a real HTTP stack
+(the env-switched matrix additionally runs every protocol test this way)."""
+
+import numpy as np
+import pytest
+import requests
+
+from sda_fixtures import new_client
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    InvalidCredentialsError,
+    NoMasking,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.rest import SdaHttpClient, TokenStore, serve_background
+from sda_tpu.server import new_mem_server
+
+
+@pytest.fixture()
+def http_ctx(tmp_path):
+    server = new_mem_server()
+    with serve_background(server) as base_url:
+        yield server, base_url, tmp_path
+
+
+def test_ping_unauthenticated(http_ctx):
+    _, base_url, tmp_path = http_ctx
+    client = SdaHttpClient(base_url, TokenStore(tmp_path))
+    assert client.ping().running
+
+
+def test_full_loop_over_http(http_ctx):
+    _, base_url, tmp_path = http_ctx
+    service = SdaHttpClient(base_url, TokenStore(tmp_path / "tokens"))
+
+    recipient = new_client(tmp_path / "recipient", service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="http-loop",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+
+    clerks = [new_client(tmp_path / f"clerk{i}", service) for i in range(3)]
+    for clerk in clerks:
+        key = clerk.new_encryption_key()
+        clerk.upload_agent()
+        clerk.upload_encryption_key(key)
+
+    recipient.begin_aggregation(agg.id)
+    for i in range(2):
+        part = new_client(tmp_path / f"part{i}", service)
+        part.upload_agent()
+        part.participate([1, 2, 3, 4], agg.id)
+    recipient.end_aggregation(agg.id)
+
+    for c in [recipient] + clerks:
+        c.run_chores(-1)
+
+    out = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(out.positive().values, [2, 4, 6, 8])
+
+    # listing with filters over the query string
+    assert recipient.service.list_aggregations(recipient.agent, "http-") == [agg.id]
+    assert recipient.service.list_aggregations(recipient.agent, "nope") == []
+    assert (
+        recipient.service.list_aggregations(recipient.agent, None, recipient.agent.id)
+        == [agg.id]
+    )
+
+
+def test_auth_and_error_mapping(http_ctx):
+    _, base_url, tmp_path = http_ctx
+    service = SdaHttpClient(base_url, TokenStore(tmp_path / "a"))
+    alice = new_client(tmp_path / "alice", service)
+    alice.upload_agent()
+
+    # wrong token: a second client claiming the same agent id with a fresh token
+    impostor_service = SdaHttpClient(base_url, TokenStore(tmp_path / "b"))
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+
+    impostor = SdaClient(alice.agent, Keystore(tmp_path / "alice"), impostor_service)
+    with pytest.raises(InvalidCredentialsError):
+        impostor_service.get_agent(impostor.agent, alice.agent.id)
+
+    # no-auth request to an authenticated route -> 401
+    resp = requests.get(f"{base_url}/v1/agents/{alice.agent.id}")
+    assert resp.status_code == 401
+
+    # missing resource -> 404 + Resource-not-found -> None at the client
+    assert service.get_agent(alice.agent, AgentId.random()) is None
+    # unknown route -> plain 404, surfaced as an error
+    resp = requests.get(f"{base_url}/v1/nope", auth=(str(alice.agent.id), "x"))
+    assert resp.status_code == 404 and "Resource-not-found" not in resp.headers
