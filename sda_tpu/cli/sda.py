@@ -182,11 +182,7 @@ def main(argv=None) -> int:
                 print(f"Created and uploaded key: {key}")
                 return 0
             if args.keys_command == "show":
-                for key_id in sorted(
-                    f[: -len(".json")]
-                    for f in __import__("os").listdir(keystore.path)
-                    if f.endswith(".json")
-                ):
+                for key_id in keystore.list_ids():
                     print(key_id)
                 return 0
 

@@ -83,6 +83,9 @@ class Filebased:
             return None
         return from_json(payload) if from_json else payload
 
+    def list_ids(self) -> list:
+        return self._dir.list_ids()
+
     # alias indirection (client-store/src/store.rs:11-40)
 
     def put_aliased(self, alias: str, obj) -> None:

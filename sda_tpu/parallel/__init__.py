@@ -1,0 +1,17 @@
+"""sda_tpu.parallel — the TPU aggregation fabric.
+
+Mesh sharding, the end-to-end ``TpuAggregator`` engine, and the int8-limb
+MXU mod-p matmul.
+"""
+
+from .engine import AggregationPlan, TpuAggregator, full_training_step, make_plan
+from .mesh import make_mesh, shard_participants
+
+__all__ = [
+    "TpuAggregator",
+    "AggregationPlan",
+    "make_plan",
+    "full_training_step",
+    "make_mesh",
+    "shard_participants",
+]

@@ -1,0 +1,300 @@
+"""The TPU aggregation fabric — SDA's hot loop as sharded mod-p kernels.
+
+This is the ``device="tpu"`` execution path of the north star
+(/root/repo/BASELINE.json): the share-generate / clerk-combine / reconstruct
+pipeline over an HBM-resident ``(participants, dim)`` tensor, replacing the
+reference's per-phone Rust loops (client/src/crypto/sharing/*,
+client/src/clerk.rs:85-86) when participants are simulated or co-hosted on
+an accelerator slice.
+
+Pipeline (all mod p, truncated-remainder representatives):
+
+1. *share*: reshape ``(P, dim) -> (P, B, k)`` batches (zero-padding the dim
+   tail exactly like batched.rs:30-43), append ``(P, B, t)`` counter-based
+   randomness, one batched matmul with the precomputed share matrix
+   ``(k+t, n)`` -> ``(P, B, n)``. The NTT pipeline is folded into that
+   matrix on host (ops/shamir.py) — on the MXU a matmul IS the fast NTT at
+   these domain sizes.
+2. *transpose + clerk-combine*: the server-side (participants x clerks)
+   transpose (server/src/snapshot.rs, stores.rs:86-101) is an axis
+   permutation here; the per-clerk modular sum is a single reduction over
+   the participant axis. Sharded over a mesh ``p`` axis this is a local
+   partial sum + ``psum`` riding ICI — no per-participant traffic at all.
+3. *reconstruct*: gather any ``reconstruction_threshold`` surviving clerk
+   rows, one ``(R, k)`` Lagrange matmul, truncate the pad
+   (batched.rs:68-98).
+
+Sharding model: ``Mesh(axes p, d)`` — participants shard over ``p``
+(the reference's "many phones" axis), the dim/batch axis shards over ``d``
+(the reference's dimension-batching axis, SURVEY.md §2.3). Clerk results
+are tiny (n x B); they end replicated after the psum, which is exactly what
+the recipient needs.
+
+dtype discipline: values live in int32 (p < 2^31), arithmetic widens to
+int64 only where products/sums require it. The int8-limb MXU path
+(``limbmatmul``) replaces the widening matmul on TPU for the bench path.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops import shamir
+from ..ops.jaxcfg import ensure_x64
+from ..protocol import AdditiveSharing, PackedShamirSharing
+
+
+@dataclass(frozen=True)
+class AggregationPlan:
+    """Host-precomputed constants for a scheme + dimension."""
+
+    modulus: int
+    dim: int
+    input_size: int  # k (1 for additive)
+    rand_size: int  # t for packed, n-1 for additive
+    share_count: int  # n
+    n_batches: int  # B = ceil(dim / k)
+    share_matrix: np.ndarray | None  # (n, k+t) packed; None for additive
+
+
+def make_plan(scheme, dim: int) -> AggregationPlan:
+    if isinstance(scheme, PackedShamirSharing):
+        k = scheme.secret_count
+        return AggregationPlan(
+            modulus=scheme.prime_modulus,
+            dim=dim,
+            input_size=k,
+            rand_size=scheme.privacy_threshold,
+            share_count=scheme.share_count,
+            n_batches=-(-dim // k),
+            share_matrix=shamir.share_matrix(scheme),
+        )
+    if isinstance(scheme, AdditiveSharing):
+        return AggregationPlan(
+            modulus=scheme.modulus,
+            dim=dim,
+            input_size=1,
+            rand_size=scheme.share_count - 1,
+            share_count=scheme.share_count,
+            n_batches=dim,
+            share_matrix=None,
+        )
+    raise TypeError(f"unknown sharing scheme {scheme!r}")
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (pure, jittable). All take/return jnp arrays.
+# ---------------------------------------------------------------------------
+
+
+def _jnp():
+    ensure_x64()
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _batch_secrets(secrets, plan: AggregationPlan):
+    """(P, d) -> (P, b, k) with zero-padded tail (batched.rs semantics).
+
+    Shape-driven (not plan.dim-driven): inside ``shard_map`` the dim axis is
+    a local shard, so the batch count comes from the actual input. The
+    sharded path requires dim divisible by k * d_size, so padding only ever
+    happens at the true global tail.
+    """
+    jnp = _jnp()
+    P, d = secrets.shape
+    nb = -(-d // plan.input_size)
+    pad = nb * plan.input_size - d
+    padded = jnp.pad(secrets, ((0, 0), (0, pad)))
+    return padded.reshape(P, nb, plan.input_size)
+
+
+def _device_randomness(key, shape, modulus):
+    """Counter-based uniform draws in [0, modulus) (simulation-grade RNG —
+    real participants draw on their own hosts; see ops/rng.py)."""
+    from ..ops.rng import uniform_mod_device
+
+    return uniform_mod_device(key, shape, modulus)
+
+
+def share_participants(secrets, key, plan: AggregationPlan, use_limbs: bool = False):
+    """(P, dim) secrets -> (P, n, B) per-clerk share tensor."""
+    jnp = _jnp()
+    from jax import lax
+
+    p = plan.modulus
+    if plan.share_matrix is None:
+        # additive: n-1 uniform draws + closing share (additive.rs:42-48)
+        P, d = secrets.shape
+        draws = _device_randomness(key, (P, plan.share_count - 1, d), p)  # (P, n-1, d)
+        total = jnp.sum(draws.astype(jnp.int64), axis=1)
+        last = lax.rem(secrets.astype(jnp.int64) - total, jnp.int64(p))
+        return jnp.concatenate([draws.astype(jnp.int64), last[:, None, :]], axis=1)
+
+    batches = _batch_secrets(secrets, plan)  # (P, b, k)
+    P, nb = batches.shape[0], batches.shape[1]
+    randomness = _device_randomness(key, (P, nb, plan.rand_size), p)
+    values = jnp.concatenate([batches.astype(jnp.int64), randomness], axis=-1)
+    S_T = jnp.asarray(plan.share_matrix.T)  # (k+t, n)
+    if use_limbs:
+        from .limbmatmul import limb_modmatmul
+
+        flat = values.reshape(-1, values.shape[-1])
+        shares = limb_modmatmul(flat, S_T, p).reshape(P, nb, -1)
+    else:
+        prods = lax.rem(values[..., :, None] * S_T[None, None, :, :], jnp.int64(p))
+        shares = lax.rem(jnp.sum(prods, axis=-2), jnp.int64(p))  # (P, B, n)
+    return jnp.swapaxes(shares, 1, 2)  # (P, n, B)
+
+
+def share_combine_limb(secrets, key, plan: AggregationPlan):
+    """Fused share + clerk-combine in limb space: (C, d) -> (W, b, n) int64.
+
+    The hot loop stays division-free: int8 MXU matmuls produce weight-grouped
+    partials, which are *summed over the participant axis first* (linearity)
+    and only then carried as a tiny (W, b, n) accumulator. Callers reduce
+    accumulators across chunks with ``lax.rem`` (values stay < p) and call
+    ``limb_recombine`` once at the very end. This is what makes the bench
+    path ~10x the naive int64 formulation on TPU: emulated 64-bit
+    multiply/divide never touches the (participants x dim) tensor.
+    """
+    jnp = _jnp()
+    from .limbmatmul import limb_partials
+
+    p = plan.modulus
+    batches = _batch_secrets(secrets, plan)  # (C, b, k)
+    C, nb = batches.shape[0], batches.shape[1]
+    randomness = _device_randomness(key, (C, nb, plan.rand_size), p)
+    values = jnp.concatenate([batches.astype(jnp.int64), randomness], axis=-1)
+    S_T = jnp.asarray(plan.share_matrix.T)  # (k+t, n)
+    partials = limb_partials(values.reshape(C * nb, -1), S_T, p)  # (W, C*nb, n)
+    W = partials.shape[0]
+    per_part = partials.reshape(W, C, nb, -1)
+    # participant-axis reduction: stay in int32 when the bound allows
+    # (partial elements <= K * 127^2 * 5), halving the reduction cost
+    K = values.shape[-1]
+    if C * K * 127 * 127 * 5 < 2**31:
+        return jnp.sum(per_part, axis=1).astype(jnp.int64)  # (W, b, n)
+    return jnp.sum(per_part.astype(jnp.int64), axis=1)  # (W, b, n)
+
+
+def clerk_combine(shares):
+    """(P, n, B) -> (n, B) local modular sums — the clerk hot loop
+    (combiner.rs:16-30) as one reduction; caller supplies the modulus rem."""
+    jnp = _jnp()
+    return jnp.sum(shares.astype(jnp.int64), axis=0)
+
+
+def reconstruct(clerk_sums, indices, scheme, dim: int):
+    """(n, B) clerk sums + surviving ``indices`` -> (dim,) aggregate."""
+    jnp = _jnp()
+    from jax import lax
+
+    if isinstance(scheme, AdditiveSharing):
+        total = jnp.sum(clerk_sums.astype(jnp.int64), axis=0)
+        return lax.rem(total, jnp.int64(scheme.modulus))[:dim]
+    p = scheme.prime_modulus
+    L = jnp.asarray(shamir.reconstruction_matrix(scheme, list(indices)))  # (k, R)
+    rows = clerk_sums[jnp.asarray(list(indices))]  # (R, B)
+    prods = lax.rem(L[:, :, None] * rows[None, :, :], jnp.int64(p))
+    secrets = lax.rem(jnp.sum(prods, axis=1), jnp.int64(p))  # (k, B)
+    return secrets.T.reshape(-1)[:dim]
+
+
+# ---------------------------------------------------------------------------
+# The sharded engine
+# ---------------------------------------------------------------------------
+
+
+class TpuAggregator:
+    """End-to-end secure-sum engine over a device mesh.
+
+    ``mesh`` axes: ``"p"`` shards participants, ``"d"`` shards the
+    batch/dim axis. Single-device use passes ``mesh=None``.
+    """
+
+    def __init__(self, scheme, dim: int, mesh=None, use_limbs: bool = False):
+        self.scheme = scheme
+        self.dim = dim
+        self.plan = make_plan(scheme, dim)
+        self.mesh = mesh
+        self.use_limbs = use_limbs
+
+    # -- single-device reference path --------------------------------------
+
+    def secure_sum(self, secrets, key, indices=None):
+        """(P, dim) -> (dim,) aggregate, all on device."""
+        jnp = _jnp()
+        from jax import lax
+
+        p = self.plan.modulus
+        shares = share_participants(secrets, key, self.plan, self.use_limbs)
+        sums = lax.rem(clerk_combine(shares), jnp.int64(p))
+        if indices is None:
+            indices = range(self.plan.share_count)
+        return reconstruct(sums, indices, self.scheme, self.dim)
+
+    # -- sharded path --------------------------------------------------------
+
+    def sharded_clerk_sums(self):
+        """Build the jitted sharded share+combine step over the mesh.
+
+        Returns fn(secrets_sharded, key) -> (n, B) clerk sums (replicated
+        over ``p``, sharded over ``d`` on the B axis).
+        """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        jnp = _jnp()
+        plan = self.plan
+        use_limbs = self.use_limbs
+        modulus = plan.modulus
+
+        def local_step(secrets, key):
+            # per-device: share own participant slice, sum locally, psum.
+            # key is folded with the device's participant-axis index so
+            # every shard draws distinct randomness.
+            idx = lax.axis_index("p")
+            key = jax.random.fold_in(key, idx)
+            shares = share_participants(secrets, key, plan, use_limbs)
+            partial = clerk_combine(shares)  # (n, B_local) int64
+            partial = lax.rem(partial, jnp.int64(modulus))
+            total = lax.psum(partial, axis_name="p")
+            return lax.rem(total, jnp.int64(modulus))
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(P("p", "d"), P()),
+            out_specs=P(None, "d") if "d" in self.mesh.axis_names else P(),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
+
+def full_training_step(scheme, dim, mesh):
+    """One full secure-aggregation round as a single jitted computation:
+    share + transpose + clerk-combine (sharded) then reconstruct + verify.
+
+    This is the "training step" analog the driver dry-runs multi-chip.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    jnp = _jnp()
+    agg = TpuAggregator(scheme, dim, mesh=mesh)
+    sums_fn = agg.sharded_clerk_sums()
+
+    def step(secrets, key):
+        sums = sums_fn(secrets, key)
+        out = reconstruct(sums, range(agg.plan.share_count), scheme, dim)
+        plain = lax.rem(jnp.sum(secrets.astype(jnp.int64), axis=0), jnp.int64(agg.plan.modulus))
+        return out, plain
+
+    return agg, jax.jit(step)

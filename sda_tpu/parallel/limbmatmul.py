@@ -1,0 +1,101 @@
+"""Exact mod-p matmul on the MXU via base-128 limb decomposition.
+
+TPUs have no native 64-bit integer multiply; XLA emulates int64 products in
+many 32-bit VPU ops. But the MXU multiplies int8 x int8 -> int32 natively
+and fast. So: decompose canonical residues (0 <= x < p < 2^31) into
+base-128 limbs (values 0..127, stored int8), matmul every limb pair on the
+MXU, and recombine partials with ``128^(i+j) mod p`` weights in int64.
+
+Exactness bounds: each partial product <= 127*127; an int32 accumulator
+holds K <= 2^31 / 127^2 = ~133k contraction elements. The share matmul
+contracts over k+t (tiny); bigger contractions would chunk K. The limb
+count is ceil(bits(p)/7), so a 31-bit modulus costs 25 int8 matmuls —
+still far cheaper on the MXU than one emulated int64 matmul on the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..ops.jaxcfg import ensure_x64
+
+# int32 bound for one weight group: up to L=5 partial matmuls summed, each
+# elementwise <= K * 127^2
+_MAX_CONTRACTION = (1 << 31) // (127 * 127 * 5)
+
+
+def limb_count(p: int) -> int:
+    return -(-p.bit_length() // 7)
+
+
+def limb_partials(A, B, p: int):
+    """Weight-grouped limb partial products of (M, K) @ (K, N) mod p.
+
+    Returns int32 ``(W, M, N)`` with ``W = 2*L-1`` such that the true
+    product is ``sum_w partials[w] * 128^w (mod p)``. This is the MXU-only
+    piece: recombination (the int64 multiply/rem work) can be deferred —
+    crucially, *summed over batch axes first* (linearity), which is how the
+    clerk-combine keeps all mod-p arithmetic out of the participant loop.
+    """
+    ensure_x64()
+    import jax.numpy as jnp
+    from jax import lax
+
+    K = A.shape[-1]
+    if K > _MAX_CONTRACTION:
+        raise ValueError(f"contraction {K} overflows int32 accumulator; chunk first")
+    L = limb_count(p)
+
+    def limbs(x, count):
+        # canonical values < p < 2^31 fit int32: extract limbs in 32-bit
+        # lanes (native on TPU) instead of emulated 64-bit shifts
+        x = x.astype(jnp.int32) if p <= (1 << 31) else x.astype(jnp.int64)
+        seven = x.dtype.type(0x7F)
+        return [
+            ((x >> x.dtype.type(7 * i)) & seven).astype(jnp.int8) for i in range(count)
+        ]
+
+    a_limbs = limbs(A, L)
+    b_limbs = limbs(B, L)
+    partials = [None] * (2 * L - 1)
+    for i in range(L):
+        for j in range(L):
+            prod = lax.dot_general(
+                a_limbs[i],
+                b_limbs[j],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            w = i + j
+            partials[w] = prod if partials[w] is None else partials[w] + prod
+    return jnp.stack(partials)  # (W, M, N) int32
+
+
+def limb_recombine(partials, p: int):
+    """(W, ...) partials (each < 2^31) -> canonical mod-p values.
+
+    int64 multiply + rem on whatever shape you pass — call this on the
+    *reduced* accumulator, never inside the hot loop.
+    """
+    ensure_x64()
+    import jax.numpy as jnp
+    from jax import lax
+
+    W = partials.shape[0]
+    weights = jnp.asarray(
+        [pow(128, w, p) for w in range(W)], dtype=jnp.int64
+    ).reshape((W,) + (1,) * (partials.ndim - 1))
+    acc = jnp.sum(
+        lax.rem(partials.astype(jnp.int64) * weights, jnp.int64(p)), axis=0
+    )
+    return lax.rem(acc, jnp.int64(p))
+
+
+def limb_modmatmul(A, B, p: int):
+    """(M, K) @ (K, N) mod p, inputs canonical [0, p), output canonical.
+
+    Jittable; int8 MXU matmuls inside, int64 only in the recombine. When
+    the product feeds a sum over a batch axis, prefer ``limb_partials`` +
+    reduce + ``limb_recombine`` to keep the int64 work off the big tensor.
+    """
+    return limb_recombine(limb_partials(A, B, p), p)

@@ -1,0 +1,35 @@
+"""Device-mesh construction for the aggregation fabric.
+
+Axes: ``p`` shards participants (the "many phones" axis), ``d`` shards the
+dim/batch axis (the reference's dimension-batching, SURVEY.md §2.3). On a
+v5e-8 slice the default is all 8 chips on ``p`` — participant count dwarfs
+everything else — with ``d`` available for 100K-dim vectors when per-chip
+batch memory binds first.
+"""
+
+from __future__ import annotations
+
+
+def make_mesh(p_size: int | None = None, d_size: int = 1):
+    """Mesh over the first p_size*d_size local devices, axes ('p', 'd')."""
+    import jax
+    from jax.sharding import Mesh
+
+    import numpy as np
+
+    devices = jax.devices()
+    if p_size is None:
+        p_size = len(devices) // d_size
+    need = p_size * d_size
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(p_size, d_size)
+    return Mesh(grid, ("p", "d"))
+
+
+def shard_participants(array, mesh):
+    """Place a (P, dim) array sharded (p, d) over the mesh."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(array, NamedSharding(mesh, P("p", "d")))

@@ -60,6 +60,14 @@ class FileAuthTokensStore(AuthTokensStore):
     def upsert_auth_token(self, token) -> None:
         self.dir.put(token.id, {"id": str(token.id), "body": token.body})
 
+    def register_auth_token(self, token) -> bool:
+        # JsonDir.create is atomic under the per-directory lock
+        try:
+            self.dir.create(token.id, {"id": str(token.id), "body": token.body})
+            return True
+        except ConflictError:
+            return False
+
     def get_auth_token(self, agent_id):
         payload = self.dir.get(agent_id)
         if payload is None:

@@ -33,6 +33,14 @@ class MemAuthTokensStore(AuthTokensStore):
         with self._lock:
             self._tokens[token.id] = token
 
+    def register_auth_token(self, token) -> bool:
+        with self._lock:
+            existing = self._tokens.get(token.id)
+            if existing is None:
+                self._tokens[token.id] = token
+                return True
+            return existing == token
+
     def get_auth_token(self, agent_id):
         with self._lock:
             return self._tokens.get(agent_id)

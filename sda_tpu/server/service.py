@@ -72,12 +72,37 @@ class SdaServer:
 
     def create_aggregation(self, aggregation) -> None:
         from ..ops.modular import MAX_SAFE_MODULUS
+        from ..protocol import ChaChaMasking
 
         if not 0 < aggregation.modulus < MAX_SAFE_MODULUS:
             raise InvalidRequestError(
                 f"modulus {aggregation.modulus} outside (0, 2^31): the int64 "
                 "math plane guarantees exactness only below 2^31 (larger "
                 "moduli need the limb-decomposed kernels)"
+            )
+        # the math plane computes with the SCHEME-embedded moduli, so they
+        # must match the aggregation's group (and obey the same bound) —
+        # a mismatch silently corrupts the revealed aggregate
+        sharing = aggregation.committee_sharing_scheme
+        scheme_modulus = getattr(sharing, "modulus", None) or getattr(
+            sharing, "prime_modulus", None
+        )
+        if scheme_modulus != aggregation.modulus:
+            raise InvalidRequestError(
+                "committee sharing scheme modulus differs from aggregation modulus"
+            )
+        masking = aggregation.masking_scheme
+        mask_modulus = getattr(masking, "modulus", None)
+        if mask_modulus is not None and mask_modulus != aggregation.modulus:
+            raise InvalidRequestError(
+                "masking scheme modulus differs from aggregation modulus"
+            )
+        if (
+            isinstance(masking, ChaChaMasking)
+            and masking.dimension != aggregation.vector_dimension
+        ):
+            raise InvalidRequestError(
+                "ChaCha masking dimension differs from aggregation vector dimension"
             )
         self.aggregation_store.create_aggregation(aggregation)
 
@@ -191,11 +216,9 @@ class SdaServer:
         """Trust-on-first-use registration: the first token presented for an
         agent id sticks; later attempts with a different token are rejected
         (otherwise anyone could re-post a public Agent object and hijack the
-        account by overwriting its token)."""
-        existing = self.auth_tokens_store.get_auth_token(token.id)
-        if existing is None:
-            self.auth_tokens_store.upsert_auth_token(token)
-        elif existing != token:
+        account by overwriting its token). Delegated to the store as one
+        atomic check-and-write."""
+        if not self.auth_tokens_store.register_auth_token(token):
             raise InvalidCredentialsError("agent already registered")
 
     def check_auth_token(self, token):

@@ -33,6 +33,14 @@ class AuthTokensStore(BaseStore):
     def upsert_auth_token(self, token: AuthToken) -> None: ...
 
     @abc.abstractmethod
+    def register_auth_token(self, token: AuthToken) -> bool:
+        """Atomic trust-on-first-use registration: record the token if the
+        agent id has none yet; return whether the presented token is now
+        the valid one (existing identical token also returns True).
+        Check-and-write must be one atomic operation — two concurrent first
+        registrations must not last-writer-win."""
+
+    @abc.abstractmethod
     def get_auth_token(self, agent_id) -> Optional[AuthToken]: ...
 
     @abc.abstractmethod

@@ -23,11 +23,27 @@ class ConflictError(Exception):
     """create() saw an existing object with different content."""
 
 
+# Locks are keyed by absolute directory path, not by JsonDir instance:
+# callers freely mint transient JsonDir objects for the same directory
+# (e.g. the server filestore's per-aggregation subdirs), and create()'s
+# get-then-put must serialize across all of them.
+_LOCKS: dict = {}
+_LOCKS_GUARD = threading.Lock()
+
+
+def _lock_for(path: str) -> threading.RLock:
+    with _LOCKS_GUARD:
+        lock = _LOCKS.get(path)
+        if lock is None:
+            lock = _LOCKS[path] = threading.RLock()
+        return lock
+
+
 class JsonDir:
     def __init__(self, path):
-        self.path = str(path)
+        self.path = os.path.abspath(str(path))
         os.makedirs(self.path, mode=0o700, exist_ok=True)
-        self._lock = threading.RLock()
+        self._lock = _lock_for(self.path)
 
     def _file(self, id) -> str:
         name = str(id)

@@ -8,9 +8,17 @@ separately dry-runs the multichip path; real TPU is reserved for bench.py).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# unconditionally: the suite must never grab the tunneled TPU chip
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's sitecustomize registers the axon TPU plugin and writes
+# jax_platforms directly into jax config (overriding the env var), so pin
+# the config itself too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

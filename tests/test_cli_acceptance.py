@@ -11,7 +11,9 @@ def test_simple_cli_example():
     repo = pathlib.Path(__file__).resolve().parent.parent
     env = dict(os.environ)
     env["SDA_PORT"] = "18871"
-    env.pop("JAX_PLATFORMS", None)
+    # pin subprocesses to CPU: the sitecustomize would otherwise hand them
+    # the exclusive tunneled TPU chip on their first lazy jax import
+    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         ["sh", str(repo / "scripts" / "simple-cli-example.sh")],
         capture_output=True,

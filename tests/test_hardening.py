@@ -98,6 +98,45 @@ def test_modulus_bound_enforced():
             ctx.service.create_aggregation(alice, agg)
 
 
+def test_scheme_modulus_mismatch_rejected():
+    with with_server() as ctx:
+        alice, alice_key = new_full_agent(ctx.service)
+
+        def agg(**kw):
+            base = dict(
+                id=AggregationId.random(),
+                title="m",
+                vector_dimension=4,
+                modulus=433,
+                recipient=alice.id,
+                recipient_key=alice_key.body.id,
+                masking_scheme=NoMasking(),
+                committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+                recipient_encryption_scheme=SodiumEncryptionScheme(),
+                committee_encryption_scheme=SodiumEncryptionScheme(),
+            )
+            base.update(kw)
+            return Aggregation(**base)
+
+        # sharing modulus sneaking past the bound via the scheme field
+        bad = agg(committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=1 << 40))
+        with pytest.raises(InvalidRequestError, match="differs"):
+            ctx.service.create_aggregation(alice, bad)
+        # masking modulus mismatch
+        from sda_tpu.protocol import ChaChaMasking, FullMasking
+
+        with pytest.raises(InvalidRequestError, match="differs"):
+            ctx.service.create_aggregation(alice, agg(masking_scheme=FullMasking(13)))
+        # chacha dimension mismatch (the reference CLI ships this bug:
+        # cli/src/main.rs sets dimension=share_count)
+        with pytest.raises(InvalidRequestError, match="dimension"):
+            ctx.service.create_aggregation(
+                alice, agg(masking_scheme=ChaChaMasking(433, 3, 128))
+            )
+        # and the consistent one passes
+        ctx.service.create_aggregation(alice, agg(masking_scheme=ChaChaMasking(433, 4, 128)))
+
+
 def test_snapshot_retry_idempotent_on_file_store(tmp_path):
     import numpy as np
 

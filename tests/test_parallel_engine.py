@@ -1,0 +1,138 @@
+"""TPU aggregation fabric tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from sda_tpu.ops.modular import positive
+from sda_tpu.protocol import AdditiveSharing, PackedShamirSharing
+
+PACKED = PackedShamirSharing(3, 8, 4, 433, 354, 150)
+ADDITIVE = AdditiveSharing(share_count=3, modulus=433)
+
+
+@pytest.fixture(scope="module")
+def jax_mods():
+    import jax
+
+    from sda_tpu.ops.jaxcfg import ensure_x64
+
+    ensure_x64()
+    return jax
+
+
+def _plain_sum(secrets, p):
+    return (secrets.astype(np.int64).sum(axis=0)) % p
+
+
+@pytest.mark.parametrize("scheme", [PACKED, ADDITIVE], ids=["packed", "additive"])
+def test_single_device_secure_sum(jax_mods, scheme):
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator
+
+    p = scheme.prime_modulus if isinstance(scheme, PackedShamirSharing) else scheme.modulus
+    dim = 10
+    rng = np.random.default_rng(0)
+    secrets = rng.integers(0, p, size=(17, dim))
+    agg = TpuAggregator(scheme, dim)
+    out = agg.secure_sum(jnp.asarray(secrets), random.key(0))
+    got = positive(np.asarray(out), p)
+    np.testing.assert_array_equal(got, _plain_sum(secrets, p))
+
+
+def test_single_device_dropout(jax_mods):
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator
+
+    p = PACKED.prime_modulus
+    dim = 7  # pad + truncate path
+    rng = np.random.default_rng(1)
+    secrets = rng.integers(0, p, size=(5, dim))
+    agg = TpuAggregator(PACKED, dim)
+    out = agg.secure_sum(
+        jnp.asarray(secrets), random.key(1), indices=[0, 2, 3, 4, 5, 6, 7]
+    )
+    got = positive(np.asarray(out), p)
+    np.testing.assert_array_equal(got, _plain_sum(secrets, p))
+
+
+def test_limb_modmatmul_exact(jax_mods):
+    import jax.numpy as jnp
+
+    from sda_tpu.parallel.limbmatmul import limb_modmatmul
+
+    p = (1 << 31) - 1  # worst-case width (Mersenne prime)
+    rng = np.random.default_rng(2)
+    A = rng.integers(0, p, size=(33, 20), dtype=np.int64)
+    B = rng.integers(0, p, size=(20, 9), dtype=np.int64)
+    got = np.asarray(limb_modmatmul(jnp.asarray(A), jnp.asarray(B), p))
+    # exact reference with python ints
+    want = (A.astype(object) @ B.astype(object)) % p
+    np.testing.assert_array_equal(got, want.astype(np.int64))
+
+
+def test_limb_path_matches_int64_path(jax_mods):
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator
+
+    p = PACKED.prime_modulus
+    dim = 30
+    rng = np.random.default_rng(3)
+    secrets = rng.integers(0, p, size=(9, dim))
+    out_a = TpuAggregator(PACKED, dim, use_limbs=False).secure_sum(
+        jnp.asarray(secrets), random.key(7)
+    )
+    out_b = TpuAggregator(PACKED, dim, use_limbs=True).secure_sum(
+        jnp.asarray(secrets), random.key(7)
+    )
+    np.testing.assert_array_equal(
+        positive(np.asarray(out_a), p), positive(np.asarray(out_b), p)
+    )
+
+
+def test_sharded_clerk_sums_on_mesh(jax_mods):
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator, full_training_step, make_mesh, shard_participants
+
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh(p_size=4, d_size=2)
+    p = PACKED.prime_modulus
+    dim = 24  # divisible by k * d_size = 3*2
+    P_total = 32
+    rng = np.random.default_rng(4)
+    secrets = rng.integers(0, p, size=(P_total, dim))
+
+    agg, step = full_training_step(PACKED, dim, mesh)
+    sharded = shard_participants(jnp.asarray(secrets), mesh)
+    out, plain = step(sharded, random.key(3))
+    np.testing.assert_array_equal(
+        positive(np.asarray(out), p), positive(np.asarray(plain), p)
+    )
+    np.testing.assert_array_equal(positive(np.asarray(plain), p), _plain_sum(secrets, p))
+
+
+def test_sharded_matches_engine_across_mesh_shapes(jax_mods):
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import full_training_step, make_mesh, shard_participants
+
+    p = ADDITIVE.modulus
+    dim = 16
+    rng = np.random.default_rng(5)
+    secrets = rng.integers(0, p, size=(8, dim))
+    for (ps, ds) in [(8, 1), (2, 4), (1, 8)]:
+        mesh = make_mesh(p_size=ps, d_size=ds)
+        agg, step = full_training_step(ADDITIVE, dim, mesh)
+        out, plain = step(shard_participants(jnp.asarray(secrets), mesh), random.key(9))
+        np.testing.assert_array_equal(
+            positive(np.asarray(out), p), _plain_sum(secrets, p)
+        )
