@@ -52,7 +52,7 @@ class Clerking:
         decryptor = self.crypto.new_share_decryptor(
             own_key_id, aggregation.committee_encryption_scheme
         )
-        share_vectors = [decryptor.decrypt(e) for e in job.encryptions]
+        share_vectors = decryptor.decrypt_batch(job.encryptions)
 
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
         combined = combiner.combine(share_vectors)

@@ -80,7 +80,7 @@ class Receiving:
         if result.recipient_encryptions is None:
             mask = np.empty(0, dtype=np.int64)
         else:
-            decrypted = [decryptor.decrypt(e) for e in result.recipient_encryptions]
+            decrypted = decryptor.decrypt_batch(result.recipient_encryptions)
             mask_combiner = self.crypto.new_mask_combiner(aggregation.masking_scheme)
             mask = mask_combiner.combine(decrypted)
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import native
 from ..protocol import B32, Binary, Encryption, EncryptionKey, SodiumEncryptionScheme
 from . import sodium, varint
 from .keystore import DecryptionKey, EncryptionKeypair
@@ -32,8 +33,15 @@ class SodiumEncryptor(ShareEncryptor):
         self.pk = ek.data
 
     def encrypt(self, shares):
-        encoded = varint.encode_i64(np.asarray(shares, dtype=np.int64))
+        encoded = native.varint_encode(np.asarray(shares, dtype=np.int64))
         return Encryption(Binary(sodium.seal(encoded, self.pk)))
+
+    def encrypt_batch(self, share_vectors) -> list:
+        """Seal many share vectors in one native batch call."""
+        encoded = [native.varint_encode(np.asarray(v, dtype=np.int64)) for v in share_vectors]
+        return [
+            Encryption(Binary(ct)) for ct in native.seal_batch(encoded, self.pk)
+        ]
 
 
 class SodiumDecryptor(ShareDecryptor):
@@ -43,7 +51,15 @@ class SodiumDecryptor(ShareDecryptor):
 
     def decrypt(self, encryption):
         raw = sodium.seal_open(bytes(encryption.inner), self.pk, self.sk)
-        return varint.decode_i64(raw)
+        return native.varint_decode(raw)
+
+    def decrypt_batch(self, encryptions) -> list:
+        """Open many sealed boxes in one native batch call (the clerk-side
+        per-participant loop, clerk.rs:79-82)."""
+        raws = native.open_batch(
+            [bytes(e.inner) for e in encryptions], self.pk, self.sk
+        )
+        return [native.varint_decode(r) for r in raws]
 
 
 def generate_encryption_keypair() -> EncryptionKeypair:

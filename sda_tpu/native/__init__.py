@@ -1,0 +1,50 @@
+"""sda_tpu.native — C acceleration layer with pure-Python fallbacks.
+
+``available()`` reports whether the compiled extension loaded; the crypto
+modules route bulk work through here either way.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    from . import _sdanative as _ext
+except ImportError:  # not built; fall back to the vectorized Python paths
+    _ext = None
+
+
+def available() -> bool:
+    return _ext is not None
+
+
+def varint_encode(values: np.ndarray) -> bytes:
+    if _ext is not None:
+        return _ext.varint_encode(np.ascontiguousarray(values, dtype="<i8").tobytes())
+    from ..crypto import varint
+
+    return varint.encode_i64(values)
+
+
+def varint_decode(buf: bytes) -> np.ndarray:
+    if _ext is not None:
+        return np.frombuffer(_ext.varint_decode(buf), dtype="<i8")
+    from ..crypto import varint
+
+    return varint.decode_i64(buf)
+
+
+def seal_batch(messages: list, public_key: bytes) -> list:
+    if _ext is not None:
+        return _ext.seal_batch(list(messages), public_key)
+    from ..crypto import sodium
+
+    return [sodium.seal(m, public_key) for m in messages]
+
+
+def open_batch(ciphertexts: list, public_key: bytes, secret_key: bytes) -> list:
+    if _ext is not None:
+        return _ext.open_batch(list(ciphertexts), public_key, secret_key)
+    from ..crypto import sodium
+
+    return [sodium.seal_open(c, public_key, secret_key) for c in ciphertexts]
