@@ -1,0 +1,10 @@
+#!/bin/sh
+# Full test matrix: every backend x in-process/REST bindings (the
+# reference CI matrix, Jenkinsfile:22-27, widened with sqlite).
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q
+SDA_TEST_STORE=file python -m pytest tests/test_full_loop.py tests/test_server_orchestration.py tests/test_crud.py -q
+SDA_TEST_STORE=sqlite python -m pytest tests/test_full_loop.py tests/test_server_orchestration.py tests/test_crud.py -q
+SDA_TEST_HTTP=1 python -m pytest tests/test_full_loop.py tests/test_server_orchestration.py tests/test_crud.py -q
+SDA_TEST_HTTP=1 SDA_TEST_STORE=sqlite python -m pytest tests/test_full_loop.py -q

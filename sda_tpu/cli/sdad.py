@@ -23,6 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-v", "--verbose", action="count", default=0)
     backend = parser.add_mutually_exclusive_group()
     backend.add_argument("--file", metavar="ROOT", help="durable JSON-file store root")
+    backend.add_argument("--sqlite", metavar="DB", help="sqlite database path (production)")
     backend.add_argument("--mem", action="store_true", help="in-memory store (dev)")
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd", help="run the REST server")
@@ -38,6 +39,11 @@ def main(argv=None) -> int:
     if args.file:
         service = new_file_server(args.file)
         log.info("using file store at %s", args.file)
+    elif args.sqlite:
+        from ..server import new_sqlite_server
+
+        service = new_sqlite_server(args.sqlite)
+        log.info("using sqlite store at %s", args.sqlite)
     else:
         service = new_mem_server()
         log.info("using in-memory store")

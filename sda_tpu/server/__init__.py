@@ -52,11 +52,33 @@ def new_file_server(path) -> SdaServerService:
     )
 
 
+def new_sqlite_server(path) -> SdaServerService:
+    """Production sqlite-backed server (the reference's mongo equivalent)."""
+    from .sqlstore import (
+        SqliteAgentsStore,
+        SqliteAggregationsStore,
+        SqliteAuthTokensStore,
+        SqliteBackend,
+        SqliteClerkingJobsStore,
+    )
+
+    backend = SqliteBackend(path)
+    return SdaServerService(
+        SdaServer(
+            agents_store=SqliteAgentsStore(backend),
+            auth_tokens_store=SqliteAuthTokensStore(backend),
+            aggregation_store=SqliteAggregationsStore(backend),
+            clerking_job_store=SqliteClerkingJobsStore(backend),
+        )
+    )
+
+
 __all__ = [
     "SdaServer",
     "SdaServerService",
     "new_mem_server",
     "new_file_server",
+    "new_sqlite_server",
     "BaseStore",
     "AuthToken",
     "AuthTokensStore",

@@ -1,0 +1,455 @@
+"""Production store backend: sqlite.
+
+Fills the role of the reference's MongoDB backend (server-store-mongodb/):
+durable, indexed, and — the scalability-critical part — a **streaming
+server-side transpose**. The reference runs the (participants x clerks)
+ciphertext transpose as a Mongo aggregation pipeline with disk spill
+($unwind/$group, aggregations.rs:164-195); here each clerk's column is
+extracted by the SQL engine with ``json_extract`` over an indexed snapshot
+scan, one streaming pass per clerk, so no participation set is ever
+materialized in RAM (contrast the generic in-memory transpose,
+stores.iter_snapshot_clerk_jobs_data).
+
+Job documents carry a ``done`` flag instead of queue-file moves, matching
+the mongo store's shape (clerking_jobs.rs:36-76).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Optional
+
+from ..protocol import (
+    Agent,
+    Aggregation,
+    ClerkCandidate,
+    ClerkingJob,
+    ClerkingResult,
+    Committee,
+    Encryption,
+    InvalidRequestError,
+    Labelled,
+    Participation,
+    Profile,
+    ServerError,
+    Snapshot,
+    signed_encryption_key_from_json,
+)
+from ..protocol.ids import AgentId, AggregationId, ClerkingJobId, SnapshotId
+from .stores import AggregationsStore, AgentsStore, AuthTokensStore, ClerkingJobsStore
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS agents (id TEXT PRIMARY KEY, body TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS profiles (owner TEXT PRIMARY KEY, body TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS enc_keys (
+    id TEXT PRIMARY KEY, signer TEXT NOT NULL, body TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS enc_keys_signer ON enc_keys (signer);
+CREATE TABLE IF NOT EXISTS auth_tokens (agent TEXT PRIMARY KEY, token TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS aggregations (
+    id TEXT PRIMARY KEY, title TEXT NOT NULL, recipient TEXT NOT NULL,
+    body TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS aggregations_recipient ON aggregations (recipient);
+CREATE TABLE IF NOT EXISTS committees (aggregation TEXT PRIMARY KEY, body TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS participations (
+    id TEXT PRIMARY KEY, aggregation TEXT NOT NULL, body TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS participations_agg ON participations (aggregation);
+CREATE TABLE IF NOT EXISTS snapshots (
+    id TEXT PRIMARY KEY, aggregation TEXT NOT NULL, body TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS snapshots_agg ON snapshots (aggregation);
+CREATE TABLE IF NOT EXISTS snapshot_members (
+    snapshot TEXT NOT NULL, ord INTEGER NOT NULL, participation TEXT NOT NULL,
+    PRIMARY KEY (snapshot, ord));
+CREATE TABLE IF NOT EXISTS snapshot_masks (snapshot TEXT PRIMARY KEY, body TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS jobs (
+    id TEXT PRIMARY KEY, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
+    done INTEGER NOT NULL DEFAULT 0, body TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS jobs_clerk ON jobs (clerk, done);
+CREATE TABLE IF NOT EXISTS results (
+    job TEXT PRIMARY KEY, snapshot TEXT NOT NULL, body TEXT NOT NULL);
+CREATE INDEX IF NOT EXISTS results_snapshot ON results (snapshot);
+"""
+
+
+class SqliteBackend:
+    """Shared connection + lock for all four stores over one database."""
+
+    def __init__(self, path):
+        path = str(path)
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.lock = threading.RLock()
+        with self.lock:
+            self.conn.executescript(_SCHEMA)
+            self.conn.execute("PRAGMA journal_mode=WAL")
+            self.conn.commit()
+
+    def execute(self, sql, params=()):
+        with self.lock:
+            cur = self.conn.execute(sql, params)
+            self.conn.commit()
+            return cur
+
+    def query_one(self, sql, params=()):
+        with self.lock:
+            row = self.conn.execute(sql, params).fetchone()
+        return row
+
+    def query_all(self, sql, params=()):
+        with self.lock:
+            return self.conn.execute(sql, params).fetchall()
+
+    def create_row(self, table, id_col, id_val, cols: dict):
+        """create-if-identical semantics via INSERT OR conflict check."""
+        with self.lock:
+            row = self.conn.execute(
+                f"SELECT body FROM {table} WHERE {id_col} = ?", (id_val,)
+            ).fetchone()
+            if row is not None:
+                if row[0] != cols["body"]:
+                    raise ServerError(f"object already exists: {id_val}")
+                return
+            names = ", ".join([id_col] + list(cols))
+            marks = ", ".join("?" * (1 + len(cols)))
+            self.conn.execute(
+                f"INSERT INTO {table} ({names}) VALUES ({marks})",
+                (id_val, *cols.values()),
+            )
+            self.conn.commit()
+
+
+class SqliteAuthTokensStore(AuthTokensStore):
+    def __init__(self, backend: SqliteBackend):
+        self.db = backend
+
+    def upsert_auth_token(self, token) -> None:
+        self.db.execute(
+            "INSERT INTO auth_tokens (agent, token) VALUES (?, ?) "
+            "ON CONFLICT(agent) DO UPDATE SET token = excluded.token",
+            (str(token.id), token.body),
+        )
+
+    def register_auth_token(self, token) -> bool:
+        with self.db.lock:
+            row = self.db.conn.execute(
+                "SELECT token FROM auth_tokens WHERE agent = ?", (str(token.id),)
+            ).fetchone()
+            if row is None:
+                self.db.conn.execute(
+                    "INSERT INTO auth_tokens (agent, token) VALUES (?, ?)",
+                    (str(token.id), token.body),
+                )
+                self.db.conn.commit()
+                return True
+            return row[0] == token.body
+
+    def get_auth_token(self, agent_id):
+        row = self.db.query_one(
+            "SELECT token FROM auth_tokens WHERE agent = ?", (str(agent_id),)
+        )
+        return None if row is None else Labelled(agent_id, row[0])
+
+    def delete_auth_token(self, agent_id) -> None:
+        self.db.execute("DELETE FROM auth_tokens WHERE agent = ?", (str(agent_id),))
+
+
+class SqliteAgentsStore(AgentsStore):
+    def __init__(self, backend: SqliteBackend):
+        self.db = backend
+
+    def create_agent(self, agent) -> None:
+        self.db.create_row(
+            "agents", "id", str(agent.id), {"body": json.dumps(agent.to_json())}
+        )
+
+    def get_agent(self, agent_id):
+        row = self.db.query_one("SELECT body FROM agents WHERE id = ?", (str(agent_id),))
+        return None if row is None else Agent.from_json(json.loads(row[0]))
+
+    def upsert_profile(self, profile) -> None:
+        self.db.execute(
+            "INSERT INTO profiles (owner, body) VALUES (?, ?) "
+            "ON CONFLICT(owner) DO UPDATE SET body = excluded.body",
+            (str(profile.owner), json.dumps(profile.to_json())),
+        )
+
+    def get_profile(self, owner_id):
+        row = self.db.query_one(
+            "SELECT body FROM profiles WHERE owner = ?", (str(owner_id),)
+        )
+        return None if row is None else Profile.from_json(json.loads(row[0]))
+
+    def create_encryption_key(self, signed_key) -> None:
+        self.db.create_row(
+            "enc_keys",
+            "id",
+            str(signed_key.body.id),
+            {"signer": str(signed_key.signer), "body": json.dumps(signed_key.to_json())},
+        )
+
+    def get_encryption_key(self, key_id):
+        row = self.db.query_one("SELECT body FROM enc_keys WHERE id = ?", (str(key_id),))
+        return None if row is None else signed_encryption_key_from_json(json.loads(row[0]))
+
+    def suggest_committee(self) -> list:
+        rows = self.db.query_all(
+            "SELECT k.signer, k.id FROM enc_keys k JOIN agents a ON a.id = k.signer "
+            "ORDER BY k.signer, k.id"
+        )
+        out: dict = {}
+        for signer, key_id in rows:
+            out.setdefault(signer, []).append(key_id)
+        from ..protocol.ids import EncryptionKeyId
+
+        return [
+            ClerkCandidate(id=AgentId(s), keys=[EncryptionKeyId(k) for k in keys])
+            for s, keys in out.items()
+        ]
+
+
+class SqliteAggregationsStore(AggregationsStore):
+    def __init__(self, backend: SqliteBackend):
+        self.db = backend
+
+    def list_aggregations(self, filter: Optional[str], recipient) -> list:
+        sql = "SELECT id, title, recipient FROM aggregations"
+        rows = self.db.query_all(sql)
+        out = []
+        for id_, title, rec in rows:
+            if filter is not None and filter not in title:
+                continue
+            if recipient is not None and rec != str(recipient):
+                continue
+            out.append(AggregationId(id_))
+        return out
+
+    def create_aggregation(self, aggregation) -> None:
+        self.db.create_row(
+            "aggregations",
+            "id",
+            str(aggregation.id),
+            {
+                "title": aggregation.title,
+                "recipient": str(aggregation.recipient),
+                "body": json.dumps(aggregation.to_json()),
+            },
+        )
+
+    def get_aggregation(self, aggregation_id):
+        row = self.db.query_one(
+            "SELECT body FROM aggregations WHERE id = ?", (str(aggregation_id),)
+        )
+        return None if row is None else Aggregation.from_json(json.loads(row[0]))
+
+    def delete_aggregation(self, aggregation_id) -> None:
+        a = str(aggregation_id)
+        with self.db.lock:
+            snaps = [
+                r[0]
+                for r in self.db.conn.execute(
+                    "SELECT id FROM snapshots WHERE aggregation = ?", (a,)
+                )
+            ]
+            for s in snaps:
+                self.db.conn.execute("DELETE FROM snapshot_members WHERE snapshot = ?", (s,))
+                self.db.conn.execute("DELETE FROM snapshot_masks WHERE snapshot = ?", (s,))
+            self.db.conn.execute("DELETE FROM snapshots WHERE aggregation = ?", (a,))
+            self.db.conn.execute("DELETE FROM participations WHERE aggregation = ?", (a,))
+            self.db.conn.execute("DELETE FROM committees WHERE aggregation = ?", (a,))
+            self.db.conn.execute("DELETE FROM aggregations WHERE id = ?", (a,))
+            self.db.conn.commit()
+
+    def get_committee(self, aggregation_id):
+        row = self.db.query_one(
+            "SELECT body FROM committees WHERE aggregation = ?", (str(aggregation_id),)
+        )
+        return None if row is None else Committee.from_json(json.loads(row[0]))
+
+    def create_committee(self, committee) -> None:
+        self.db.create_row(
+            "committees",
+            "aggregation",
+            str(committee.aggregation),
+            {"body": json.dumps(committee.to_json())},
+        )
+
+    def create_participation(self, participation) -> None:
+        if self.get_aggregation(participation.aggregation) is None:
+            raise InvalidRequestError(f"no aggregation {participation.aggregation}")
+        self.db.create_row(
+            "participations",
+            "id",
+            str(participation.id),
+            {
+                "aggregation": str(participation.aggregation),
+                "body": json.dumps(participation.to_json()),
+            },
+        )
+
+    def create_snapshot(self, snapshot) -> None:
+        self.db.create_row(
+            "snapshots",
+            "id",
+            str(snapshot.id),
+            {
+                "aggregation": str(snapshot.aggregation),
+                "body": json.dumps(snapshot.to_json()),
+            },
+        )
+
+    def list_snapshots(self, aggregation_id) -> list:
+        rows = self.db.query_all(
+            "SELECT id FROM snapshots WHERE aggregation = ? ORDER BY id",
+            (str(aggregation_id),),
+        )
+        return [SnapshotId(r[0]) for r in rows]
+
+    def get_snapshot(self, aggregation_id, snapshot_id):
+        row = self.db.query_one(
+            "SELECT body FROM snapshots WHERE id = ? AND aggregation = ?",
+            (str(snapshot_id), str(aggregation_id)),
+        )
+        return None if row is None else Snapshot.from_json(json.loads(row[0]))
+
+    def count_participations(self, aggregation_id) -> int:
+        row = self.db.query_one(
+            "SELECT COUNT(*) FROM participations WHERE aggregation = ?",
+            (str(aggregation_id),),
+        )
+        return row[0]
+
+    def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
+        s = str(snapshot_id)
+        with self.db.lock:
+            existing = self.db.conn.execute(
+                "SELECT COUNT(*) FROM snapshot_members WHERE snapshot = ?", (s,)
+            ).fetchone()[0]
+            if existing:
+                return  # write-once freeze (retry safety)
+            self.db.conn.execute(
+                "INSERT INTO snapshot_members (snapshot, ord, participation) "
+                "SELECT ?, ROW_NUMBER() OVER (ORDER BY id) - 1, id "
+                "FROM participations WHERE aggregation = ?",
+                (s, str(aggregation_id)),
+            )
+            self.db.conn.commit()
+
+    def iter_snapped_participations(self, aggregation_id, snapshot_id):
+        # streaming: one indexed scan, constant memory
+        with self.db.lock:
+            rows = self.db.conn.execute(
+                "SELECT p.body FROM snapshot_members m "
+                "JOIN participations p ON p.id = m.participation "
+                "WHERE m.snapshot = ? ORDER BY m.ord",
+                (str(snapshot_id),),
+            ).fetchall()
+        for (body,) in rows:
+            yield Participation.from_json(json.loads(body))
+
+    def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
+        row = self.db.query_one(
+            "SELECT COUNT(*) FROM snapshot_members WHERE snapshot = ?",
+            (str(snapshot_id),),
+        )
+        return row[0]
+
+    def iter_snapshot_clerk_jobs_data(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> list:
+        """The streaming transpose: the SQL engine extracts clerk ``ix``'s
+        ciphertext column with json_extract, one indexed pass per clerk —
+        the sqlite analog of the reference's $unwind/$group disk-spilling
+        pipeline (server-store-mongodb/src/aggregations.rs:164-195)."""
+
+        def column(ix: int):
+            with self.db.lock:
+                rows = self.db.conn.execute(
+                    "SELECT json_extract(p.body, '$.clerk_encryptions[' || ? || '][1]') "
+                    "FROM snapshot_members m "
+                    "JOIN participations p ON p.id = m.participation "
+                    "WHERE m.snapshot = ? ORDER BY m.ord",
+                    (ix, str(snapshot_id)),
+                ).fetchall()
+            return [Encryption.from_json(json.loads(r[0])) for r in rows]
+
+        return [column(ix) for ix in range(clerks_number)]
+
+    def create_snapshot_mask(self, snapshot_id, mask: list) -> None:
+        self.db.execute(
+            "INSERT INTO snapshot_masks (snapshot, body) VALUES (?, ?) "
+            "ON CONFLICT(snapshot) DO UPDATE SET body = excluded.body",
+            (str(snapshot_id), json.dumps([e.to_json() for e in mask])),
+        )
+
+    def get_snapshot_mask(self, snapshot_id):
+        row = self.db.query_one(
+            "SELECT body FROM snapshot_masks WHERE snapshot = ?", (str(snapshot_id),)
+        )
+        if row is None:
+            return None
+        return [Encryption.from_json(e) for e in json.loads(row[0])]
+
+
+class SqliteClerkingJobsStore(ClerkingJobsStore):
+    def __init__(self, backend: SqliteBackend):
+        self.db = backend
+
+    def enqueue_clerking_job(self, job) -> None:
+        with self.db.lock:
+            row = self.db.conn.execute(
+                "SELECT id FROM jobs WHERE id = ?", (str(job.id),)
+            ).fetchone()
+            if row is not None:
+                return  # idempotent under deterministic snapshot retries
+            self.db.conn.execute(
+                "INSERT INTO jobs (id, clerk, snapshot, done, body) VALUES (?, ?, ?, 0, ?)",
+                (str(job.id), str(job.clerk), str(job.snapshot), json.dumps(job.to_json())),
+            )
+            self.db.conn.commit()
+
+    def poll_clerking_job(self, clerk_id):
+        row = self.db.query_one(
+            "SELECT body FROM jobs WHERE clerk = ? AND done = 0 ORDER BY id LIMIT 1",
+            (str(clerk_id),),
+        )
+        return None if row is None else ClerkingJob.from_json(json.loads(row[0]))
+
+    def get_clerking_job(self, clerk_id, job_id):
+        row = self.db.query_one(
+            "SELECT body FROM jobs WHERE id = ? AND clerk = ?",
+            (str(job_id), str(clerk_id)),
+        )
+        return None if row is None else ClerkingJob.from_json(json.loads(row[0]))
+
+    def create_clerking_result(self, result) -> None:
+        with self.db.lock:
+            row = self.db.conn.execute(
+                "SELECT snapshot FROM jobs WHERE id = ?", (str(result.job),)
+            ).fetchone()
+            if row is None:
+                raise InvalidRequestError(f"no job {result.job}")
+            self.db.conn.execute(
+                "INSERT INTO results (job, snapshot, body) VALUES (?, ?, ?) "
+                "ON CONFLICT(job) DO UPDATE SET body = excluded.body",
+                (str(result.job), row[0], json.dumps(result.to_json())),
+            )
+            self.db.conn.execute(
+                "UPDATE jobs SET done = 1 WHERE id = ?", (str(result.job),)
+            )
+            self.db.conn.commit()
+
+    def list_results(self, snapshot_id) -> list:
+        rows = self.db.query_all(
+            "SELECT job FROM results WHERE snapshot = ? ORDER BY job", (str(snapshot_id),)
+        )
+        return [ClerkingJobId(r[0]) for r in rows]
+
+    def get_result(self, snapshot_id, job_id):
+        row = self.db.query_one(
+            "SELECT body FROM results WHERE job = ? AND snapshot = ?",
+            (str(job_id), str(snapshot_id)),
+        )
+        return None if row is None else ClerkingResult.from_json(json.loads(row[0]))

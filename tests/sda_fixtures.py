@@ -38,11 +38,19 @@ class TestContext:
 
 @contextlib.contextmanager
 def with_server():
-    if os.environ.get("SDA_TEST_STORE") == "file":
+    store = os.environ.get("SDA_TEST_STORE")
+    if store == "file":
         from sda_tpu.server import new_file_server
 
         with tempfile.TemporaryDirectory() as tmp:
             server = new_file_server(tmp)
+            yield TestContext(server=server, service=server)
+        return
+    if store == "sqlite":
+        from sda_tpu.server import new_sqlite_server
+
+        with tempfile.TemporaryDirectory() as tmp:
+            server = new_sqlite_server(os.path.join(tmp, "sda.db"))
             yield TestContext(server=server, service=server)
         return
     server = new_mem_server()

@@ -1,0 +1,122 @@
+"""Per-resource CRUD + ACL tests (port of integration-tests/tests/crud.rs),
+runnable against every backend via the fixture matrix."""
+
+import pytest
+
+from sda_fixtures import new_agent, new_full_agent, new_key_for_agent, with_service
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    InvalidCredentialsError,
+    Labelled,
+    NoMasking,
+    PermissionDeniedError,
+    Profile,
+    SodiumEncryptionScheme,
+)
+
+
+def test_ping():
+    with with_service() as ctx:
+        assert ctx.server.ping().running
+
+
+def test_agent_crud():
+    with with_service() as ctx:
+        alice = new_agent()
+        ctx.server.create_agent(alice, alice)
+        assert ctx.server.get_agent(alice, alice.id) == alice
+        assert ctx.server.get_agent(alice, AgentId.random()) is None
+
+
+def test_profile_crud():
+    with with_service() as ctx:
+        alice = new_agent()
+        ctx.server.create_agent(alice, alice)
+        assert ctx.server.get_profile(alice, alice.id) is None
+
+        profile = Profile(owner=alice.id, name="alice")
+        ctx.server.upsert_profile(alice, profile)
+        assert ctx.server.get_profile(alice, alice.id) == profile
+
+        updated = Profile(owner=alice.id, name="still alice")
+        ctx.server.upsert_profile(alice, updated)
+        assert ctx.server.get_profile(alice, alice.id) == updated
+
+
+def test_profile_acl():
+    with with_service() as ctx:
+        alice = new_agent()
+        bob = new_agent()
+        ctx.server.create_agent(bob, bob)
+        fake = Profile(owner=alice.id, name="bob")
+        with pytest.raises(PermissionDeniedError):
+            ctx.server.upsert_profile(bob, fake)
+
+
+def test_encryption_key_crud():
+    with with_service() as ctx:
+        alice = new_agent()
+        bob = new_agent()
+        ctx.server.create_agent(alice, alice)
+        ctx.server.create_agent(bob, bob)
+        alice_key = new_key_for_agent(alice)
+        ctx.server.create_encryption_key(alice, alice_key)
+        assert ctx.server.get_encryption_key(bob, alice_key.body.id) == alice_key
+        # caller must be the signer
+        bob_key_forged = new_key_for_agent(alice)
+        with pytest.raises(PermissionDeniedError):
+            ctx.server.create_encryption_key(bob, bob_key_forged)
+
+
+def test_auth_tokens_crud():
+    with with_service() as ctx:
+        server = ctx.server.server
+        alice = new_agent()
+        token = Labelled(alice.id, "tok")
+        with pytest.raises(InvalidCredentialsError):
+            server.check_auth_token(token)
+        ctx.server.create_agent(alice, alice)
+        server.upsert_auth_token(token)
+        assert server.check_auth_token(token) == alice
+        token_new = Labelled(alice.id, "token")
+        with pytest.raises(InvalidCredentialsError):
+            server.check_auth_token(token_new)
+        server.upsert_auth_token(token_new)
+        assert server.check_auth_token(token_new) == alice
+        with pytest.raises(InvalidCredentialsError):
+            server.check_auth_token(token)
+        server.delete_auth_token(alice.id)
+        for t in (token, token_new):
+            with pytest.raises(InvalidCredentialsError):
+                server.check_auth_token(t)
+
+
+def test_aggregation_crud():
+    with with_service() as ctx:
+        alice, alice_key = new_full_agent(ctx.service)
+        assert ctx.service.list_aggregations(alice, None, None) == []
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="foo",
+            vector_dimension=4,
+            modulus=13,
+            recipient=alice.id,
+            recipient_key=alice_key.body.id,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=13),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        ctx.service.create_aggregation(alice, agg)
+        assert len(ctx.service.list_aggregations(alice, "bar", None)) == 0
+        assert len(ctx.service.list_aggregations(alice, "foo", None)) == 1
+        assert len(ctx.service.list_aggregations(alice, "oo", None)) == 1
+        assert len(ctx.service.list_aggregations(alice, None, AgentId.random())) == 0
+        assert len(ctx.service.list_aggregations(alice, None, alice.id)) == 1
+        assert ctx.service.get_aggregation(alice, agg.id) == agg
+        ctx.service.delete_aggregation(alice, agg.id)
+        assert ctx.service.get_aggregation(alice, agg.id) is None
+        assert ctx.service.list_aggregations(alice, None, None) == []
