@@ -36,6 +36,12 @@ def main() -> int:
     parser.add_argument("--privacy-threshold", type=int, default=2)
     parser.add_argument("--share-count", type=int, default=8)
     parser.add_argument("--no-limbs", action="store_true")
+    parser.add_argument(
+        "--wide",
+        action="store_true",
+        help="61-bit modulus (BASELINE config 5); forces the limb path with "
+        "exact host recombine of the tiny accumulator",
+    )
     args = parser.parse_args()
 
     import jax
@@ -55,14 +61,15 @@ def main() -> int:
         share_combine_limb,
         share_participants,
     )
-    from sda_tpu.parallel.limbmatmul import limb_count, limb_recombine
+    from sda_tpu.parallel.limbmatmul import limb_count
     from sda_tpu.protocol import PackedShamirSharing
 
     dev = jax.devices()[0]
     print(f"device: {dev}", file=sys.stderr)
 
     k, t, n = args.secret_count, args.privacy_threshold, args.share_count
-    p, w2, w3 = find_packed_parameters(k, t, n, min_modulus_bits=30, seed=0)
+    bits = 60 if args.wide else 30
+    p, w2, w3 = find_packed_parameters(k, t, n, min_modulus_bits=bits, seed=0)
     scheme = PackedShamirSharing(k, n, t, p, w2, w3)
     dim = args.dim
     agg = TpuAggregator(scheme, dim, use_limbs=not args.no_limbs)
@@ -75,7 +82,7 @@ def main() -> int:
 
     B = plan.n_batches
     W = 2 * limb_count(p) - 1
-    use_limbs = not args.no_limbs
+    use_limbs = not args.no_limbs or args.wide
 
     def body(carry, i):
         acc, plain, key = carry
@@ -89,9 +96,14 @@ def main() -> int:
             acc = lax.rem(
                 acc + lax.rem(clerk_combine(shares), jnp.int64(p)), jnp.int64(p)
             )
-        plain = lax.rem(
-            plain + lax.rem(jnp.sum(secrets, axis=0), jnp.int64(p)), jnp.int64(p)
-        )
+        if args.wide:
+            from sda_tpu.ops.modular import mod_sum_wide_jnp
+
+            plain = lax.rem(plain + mod_sum_wide_jnp(secrets, p, axis=0), jnp.int64(p))
+        else:
+            plain = lax.rem(
+                plain + lax.rem(jnp.sum(secrets, axis=0), jnp.int64(p)), jnp.int64(p)
+            )
         return (acc, plain, key), ()
 
     acc_shape = (W, B, n) if use_limbs else (n, B)
@@ -101,17 +113,23 @@ def main() -> int:
         acc = jnp.zeros(acc_shape, dtype=jnp.int64)
         plain = jnp.zeros((dim,), dtype=jnp.int64)
         (acc, plain, _), _ = lax.scan(body, (acc, plain, key), jnp.arange(n_chunks))
-        if use_limbs:
-            acc = limb_recombine(acc, p).T  # (n, B) canonical
         return acc, plain
 
+    from sda_tpu.parallel.limbmatmul import limb_recombine_host
+
+    def run_to_host(key):
+        acc, plain = run(key)
+        acc = np.asarray(acc)  # host transfer forces completion
+        if use_limbs:
+            acc = limb_recombine_host(acc, p).T  # (n, B) canonical, exact
+        return acc, np.asarray(plain)
+
     t0 = time.perf_counter()
-    acc, plain = np.asarray(run(jax.random.key(42))[0]), None
+    run_to_host(jax.random.key(42))
     compile_and_first = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    acc, plain = run(jax.random.key(43))
-    acc, plain = np.asarray(acc), np.asarray(plain)  # host transfer forces completion
+    acc, plain = run_to_host(jax.random.key(43))
     steady = time.perf_counter() - t0
 
     # reconstruct + verify (any t+k of n clerks; drop one for the dropout path)

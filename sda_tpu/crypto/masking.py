@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops.chacha import expand_seed
-from ..ops.modular import rust_rem_np
+from ..ops.modular import mod_sum_wide_np, rust_rem_np
 from ..ops.rng import uniform_mod_host
 from ..protocol import ChaChaMasking, FullMasking, NoMasking
 
@@ -63,8 +63,8 @@ class FullMasker(SecretMasker, MaskCombiner, SecretUnmasker):
     def combine(self, masks):
         if not masks:
             return np.empty(0, dtype=np.int64)
-        total = np.sum(np.stack([np.asarray(m, dtype=np.int64) for m in masks]), axis=0)
-        return rust_rem_np(total, self.modulus)
+        stack = np.stack([np.asarray(m, dtype=np.int64) for m in masks])
+        return mod_sum_wide_np(stack, self.modulus, axis=0)
 
     def unmask(self, mask, masked):
         return rust_rem_np(np.asarray(masked, np.int64) - np.asarray(mask, np.int64), self.modulus)

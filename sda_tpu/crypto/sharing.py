@@ -17,7 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import shamir
-from ..ops.modular import modmatmul_np, rust_rem_np
+from ..ops.modular import MAX_SAFE_MODULUS, mod_sum_wide_np, modmatmul_np, rust_rem_np
 from ..ops.rng import uniform_mod_host
 from ..protocol import AdditiveSharing, PackedShamirSharing
 
@@ -65,7 +65,8 @@ class AdditiveShareGenerator(ShareGenerator):
         secrets = np.asarray(secrets, dtype=np.int64)
         dim = len(secrets)
         draws = uniform_mod_host((self.share_count - 1, dim), self.modulus)
-        last = rust_rem_np(secrets - draws.sum(axis=0), self.modulus)
+        total = mod_sum_wide_np(draws, self.modulus, axis=0)
+        last = rust_rem_np(secrets - total, self.modulus)
         return np.concatenate([draws, last[None, :]], axis=0)
 
 
@@ -98,7 +99,9 @@ class Combiner(ShareCombiner):
 
     def combine(self, share_vectors):
         stack = np.stack([np.asarray(v, dtype=np.int64) for v in share_vectors])
-        return rust_rem_np(stack.sum(axis=0), self.modulus)
+        if self.modulus < MAX_SAFE_MODULUS and len(stack) < (1 << 32):
+            return rust_rem_np(stack.sum(axis=0), self.modulus)
+        return mod_sum_wide_np(stack, self.modulus, axis=0)
 
 
 class AdditiveReconstructor(SecretReconstructor):
@@ -107,7 +110,7 @@ class AdditiveReconstructor(SecretReconstructor):
 
     def reconstruct(self, indexed_shares):
         stack = np.stack([np.asarray(v, dtype=np.int64) for _, v in indexed_shares])
-        return rust_rem_np(stack.sum(axis=0), self.modulus)
+        return mod_sum_wide_np(stack, self.modulus, axis=0)
 
 
 class PackedShamirReconstructor(SecretReconstructor):

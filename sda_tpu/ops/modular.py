@@ -20,10 +20,13 @@ from __future__ import annotations
 
 import numpy as np
 
-# The int64 plane is exact only for moduli below 2**31 (products < 2**62,
-# sums of < 2**32 reduced terms). Aggregation creation enforces this bound;
-# the limb-decomposed kernels will lift it to 61-bit moduli.
+# Fast int64 plane: exact only for moduli below 2**31 (products < 2**62,
+# sums of < 2**32 reduced terms). Larger moduli (up to WIDE_MAX_MODULUS,
+# covering the 61-bit federated config) route through the wide paths:
+# halving mod-sums (pair sums < 2**63 stay exact) and exact object-dtype /
+# limb-space multiplication.
 MAX_SAFE_MODULUS = 1 << 31
+WIDE_MAX_MODULUS = 1 << 62
 
 
 def rust_rem_np(x, m):
@@ -72,13 +75,36 @@ def mod_inverse(a: int, m: int) -> int:
     return pow(a, m - 2, m)
 
 
-def modmatmul_np(A: np.ndarray, B: np.ndarray, m: int) -> np.ndarray:
-    """Exact (A @ B) mod m over int64 for m < 2**31.
+def mod_sum_wide_np(x: np.ndarray, m: int, axis: int = 0) -> np.ndarray:
+    """Exact sum-mod-m along ``axis`` for any m < 2**62.
 
-    Products are reduced before the K-sum so the int64 accumulator cannot
-    overflow for any K < 2**32: each reduced product lies in (-m, m).
+    Halving reduction: each level pairs elements (both in (-m, m), so the
+    pair sum stays within int64) and reduces, log2(n) vectorized passes.
+    """
+    x = np.moveaxis(np.asarray(x, dtype=np.int64), axis, 0)
+    while x.shape[0] > 1:
+        half = x.shape[0] // 2
+        paired = rust_rem_np(x[:half] + x[half : 2 * half], m)
+        if x.shape[0] % 2:
+            paired = np.concatenate([paired, x[-1:]], axis=0)
+        x = paired
+    return x[0]
+
+
+def modmatmul_np(A: np.ndarray, B: np.ndarray, m: int) -> np.ndarray:
+    """Exact (A @ B) mod m.
+
+    m < 2**31: int64 path — products reduced before the K-sum so the
+    accumulator cannot overflow for any K < 2**32. Larger m (to 2**62):
+    exact arbitrary-precision object-dtype path (the host protocol plane is
+    not the hot loop; the device hot loop uses limb kernels instead).
     Result keeps truncated-remainder representatives in (-m, m).
     """
+    if m >= MAX_SAFE_MODULUS:
+        A = np.asarray(A, dtype=object)
+        B = np.asarray(B, dtype=object)
+        out = A @ B
+        return np.vectorize(lambda v: rust_rem_int(int(v), m), otypes=[np.int64])(out)
     A = np.asarray(A, dtype=np.int64)
     B = np.asarray(B, dtype=np.int64)
     prods = rust_rem_np(A[..., :, None] * B[None, ...], m)  # (..., K, N)
@@ -123,6 +149,31 @@ def mod_sum_jnp(x, m, axis):
     ensure_x64()
     s = jnp.sum(x.astype(jnp.int64), axis=axis)
     return lax.rem(s, jnp.asarray(m, dtype=s.dtype))
+
+
+def mod_sum_wide_jnp(x, m, axis: int = 0):
+    """Device halving sum-mod-m along ``axis``; exact for m < 2**62.
+
+    Static log2 unrolled pairing (jit-friendly): pads to a power of two
+    with zeros, pair sums stay within int64.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    x = jnp.moveaxis(x.astype(jnp.int64), axis, 0)
+    n = x.shape[0]
+    levels = max(1, (n - 1).bit_length())
+    pad = (1 << levels) - n
+    if pad:
+        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+    mm = jnp.int64(m)
+    for _ in range(levels):
+        half = x.shape[0] // 2
+        x = lax.rem(x[:half] + x[half:], mm)
+    return x[0]
 
 
 def modmatmul_jnp(A, B, m):

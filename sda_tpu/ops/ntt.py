@@ -34,11 +34,17 @@ def dft_matrix(omega: int, n: int, p: int) -> np.ndarray:
 
 
 def inverse_dft_matrix(omega: int, n: int, p: int) -> np.ndarray:
-    """V^-1[i, j] = n^-1 * omega^(-i*j) mod p."""
+    """V^-1[i, j] = n^-1 * omega^(-i*j) mod p.
+
+    Scaled with exact python ints — an int64 elementwise multiply would
+    overflow for wide (61-bit) moduli.
+    """
     n_inv = pow(n, p - 2, p)
     omega_inv = pow(omega, p - 2, p)
     V = dft_matrix(omega_inv, n, p)
-    return (V * n_inv) % p
+    return np.array(
+        [[int(v) * n_inv % p for v in row] for row in V], dtype=np.int64
+    )
 
 
 def ntt(values: np.ndarray, omega: int, p: int) -> np.ndarray:

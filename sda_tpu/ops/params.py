@@ -152,10 +152,8 @@ def find_packed_parameters(
     b = round(math.log(m3, 3))
     if 3**b != m3:
         raise ValueError(f"share_count+1={m3} must be a power of 3")
-    if min_modulus_bits > 30:
-        raise ValueError(
-            "moduli >= 2^31 exceed the int64 math plane (limb kernels pending)"
-        )
+    if min_modulus_bits > 61:
+        raise ValueError("moduli >= 2^62 exceed the wide math plane")
     step = m2 * m3
     c = (2**min_modulus_bits) // step + 1
     while not is_prime(c * step + 1):

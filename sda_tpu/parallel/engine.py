@@ -146,6 +146,11 @@ def share_participants(secrets, key, plan: AggregationPlan, use_limbs: bool = Fa
         flat = values.reshape(-1, values.shape[-1])
         shares = limb_modmatmul(flat, S_T, p).reshape(P, nb, -1)
     else:
+        if p >= (1 << 31):
+            raise ValueError(
+                "int64 share products overflow for p >= 2^31; use the limb "
+                "path (share_combine_limb + limb_recombine_host)"
+            )
         prods = lax.rem(values[..., :, None] * S_T[None, None, :, :], jnp.int64(p))
         shares = lax.rem(jnp.sum(prods, axis=-2), jnp.int64(p))  # (P, B, n)
     return jnp.swapaxes(shares, 1, 2)  # (P, n, B)
@@ -175,9 +180,11 @@ def share_combine_limb(secrets, key, plan: AggregationPlan):
     W = partials.shape[0]
     per_part = partials.reshape(W, C, nb, -1)
     # participant-axis reduction: stay in int32 when the bound allows
-    # (partial elements <= K * 127^2 * 5), halving the reduction cost
+    # (partial elements <= K * 127^2 * L), halving the reduction cost
+    from .limbmatmul import limb_count
+
     K = values.shape[-1]
-    if C * K * 127 * 127 * 5 < 2**31:
+    if C * K * 127 * 127 * limb_count(p) < 2**31:
         return jnp.sum(per_part, axis=1).astype(jnp.int64)  # (W, b, n)
     return jnp.sum(per_part.astype(jnp.int64), axis=1)  # (W, b, n)
 
@@ -198,6 +205,14 @@ def reconstruct(clerk_sums, indices, scheme, dim: int):
         total = jnp.sum(clerk_sums.astype(jnp.int64), axis=0)
         return lax.rem(total, jnp.int64(scheme.modulus))[:dim]
     p = scheme.prime_modulus
+    if p >= (1 << 31):
+        # wide modulus: tiny matrices, exact host interpolation
+        import numpy as np
+
+        L = shamir.reconstruction_matrix(scheme, list(indices))  # (k, R)
+        rows = np.asarray(clerk_sums)[list(indices)]  # (R, B)
+        secrets = shamir.reconstruct_batches(rows.T, L, p)  # (B, k)
+        return jnp.asarray(secrets.reshape(-1)[:dim])
     L = jnp.asarray(shamir.reconstruction_matrix(scheme, list(indices)))  # (k, R)
     rows = clerk_sums[jnp.asarray(list(indices))]  # (R, B)
     prods = lax.rem(L[:, :, None] * rows[None, :, :], jnp.int64(p))

@@ -19,9 +19,10 @@ import functools
 
 from ..ops.jaxcfg import ensure_x64
 
-# int32 bound for one weight group: up to L=5 partial matmuls summed, each
-# elementwise <= K * 127^2
-_MAX_CONTRACTION = (1 << 31) // (127 * 127 * 5)
+def _max_contraction(L: int) -> int:
+    """int32 bound for one weight group: up to L partial matmuls summed,
+    each elementwise <= K * 127^2."""
+    return (1 << 31) // (127 * 127 * L)
 
 
 def limb_count(p: int) -> int:
@@ -42,9 +43,9 @@ def limb_partials(A, B, p: int):
     from jax import lax
 
     K = A.shape[-1]
-    if K > _MAX_CONTRACTION:
-        raise ValueError(f"contraction {K} overflows int32 accumulator; chunk first")
     L = limb_count(p)
+    if K > _max_contraction(L):
+        raise ValueError(f"contraction {K} overflows int32 accumulator; chunk first")
 
     def limbs(x, count):
         # canonical values < p < 2^31 fit int32: extract limbs in 32-bit
@@ -81,6 +82,11 @@ def limb_recombine(partials, p: int):
     import jax.numpy as jnp
     from jax import lax
 
+    if p >= (1 << 31):
+        raise ValueError(
+            "device recombine needs p < 2^31 (weight products would overflow "
+            "int64); reduce the accumulator and use limb_recombine_host"
+        )
     W = partials.shape[0]
     weights = jnp.asarray(
         [pow(128, w, p) for w in range(W)], dtype=jnp.int64
@@ -99,3 +105,17 @@ def limb_modmatmul(A, B, p: int):
     reduce + ``limb_recombine`` to keep the int64 work off the big tensor.
     """
     return limb_recombine(limb_partials(A, B, p), p)
+
+
+def limb_recombine_host(partials, p: int):
+    """Exact host recombine for wide moduli (p >= 2^31): the weighted sum
+    ``sum_w partials[w] * 128^w mod p`` overflows int64 on device, but the
+    accumulator this runs on is tiny (W x batches x clerks), so python-int
+    arithmetic is fine. Returns canonical int64 values."""
+    import numpy as np
+
+    arr = np.asarray(partials, dtype=object)
+    out = np.zeros(arr.shape[1:], dtype=object)
+    for w in range(arr.shape[0]):
+        out = (out + arr[w] * pow(128, w, p)) % p
+    return out.astype(np.int64)

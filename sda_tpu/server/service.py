@@ -71,14 +71,13 @@ class SdaServer:
         return self.aggregation_store.get_committee(aggregation_id)
 
     def create_aggregation(self, aggregation) -> None:
-        from ..ops.modular import MAX_SAFE_MODULUS
+        from ..ops.modular import WIDE_MAX_MODULUS
         from ..protocol import ChaChaMasking
 
-        if not 0 < aggregation.modulus < MAX_SAFE_MODULUS:
+        if not 0 < aggregation.modulus < WIDE_MAX_MODULUS:
             raise InvalidRequestError(
-                f"modulus {aggregation.modulus} outside (0, 2^31): the int64 "
-                "math plane guarantees exactness only below 2^31 (larger "
-                "moduli need the limb-decomposed kernels)"
+                f"modulus {aggregation.modulus} outside (0, 2^62): beyond the "
+                "exactness bound of the wide math plane"
             )
         # the math plane computes with the SCHEME-embedded moduli, so they
         # must match the aggregation's group (and obey the same bound) —

@@ -82,20 +82,25 @@ def test_duplicate_committee_rejected():
 def test_modulus_bound_enforced():
     with with_server() as ctx:
         alice, alice_key = new_full_agent(ctx.service)
-        agg = Aggregation(
-            id=AggregationId.random(),
-            title="big",
-            vector_dimension=4,
-            modulus=1 << 40,
-            recipient=alice.id,
-            recipient_key=alice_key.body.id,
-            masking_scheme=NoMasking(),
-            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=1 << 40),
-            recipient_encryption_scheme=SodiumEncryptionScheme(),
-            committee_encryption_scheme=SodiumEncryptionScheme(),
-        )
-        with pytest.raises(InvalidRequestError, match="2\\^31"):
-            ctx.service.create_aggregation(alice, agg)
+
+        def agg(m):
+            return Aggregation(
+                id=AggregationId.random(),
+                title="big",
+                vector_dimension=4,
+                modulus=m,
+                recipient=alice.id,
+                recipient_key=alice_key.body.id,
+                masking_scheme=NoMasking(),
+                committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=m),
+                recipient_encryption_scheme=SodiumEncryptionScheme(),
+                committee_encryption_scheme=SodiumEncryptionScheme(),
+            )
+
+        with pytest.raises(InvalidRequestError, match="2\\^62"):
+            ctx.service.create_aggregation(alice, agg(1 << 63))
+        # a 61-bit modulus is inside the wide plane and accepted
+        ctx.service.create_aggregation(alice, agg((1 << 61) - 1))
 
 
 def test_scheme_modulus_mismatch_rejected():

@@ -1,0 +1,103 @@
+"""61-bit modulus (the federated config, BASELINE.md #5) end-to-end.
+
+The wide math plane: halving mod-sums keep int64 exact for add/sub schemes,
+and packed-Shamir matmuls route through the exact object-dtype path on host
+(device hot loop uses limb kernels, tested in test_parallel_engine)."""
+
+import numpy as np
+
+from sda_fixtures import new_client, with_service
+from sda_tpu.ops import find_packed_parameters
+from sda_tpu.ops.modular import mod_sum_wide_np, positive, rust_rem_np
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    EncryptionKeyId,
+    FullMasking,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+
+P61 = 2305843009213693951  # 2^61 - 1, Mersenne prime
+
+
+def test_mod_sum_wide_exact_at_61_bits():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, P61, size=(101, 17), dtype=np.int64)
+    got = positive(mod_sum_wide_np(x, P61, axis=0), P61)
+    want = np.array(
+        [sum(int(v) for v in x[:, j]) % P61 for j in range(x.shape[1])], dtype=np.int64
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_full_loop_61bit_additive_with_mask(tmp_path):
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        rkey = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(rkey)
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="wide",
+            vector_dimension=6,
+            modulus=P61,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=FullMasking(modulus=P61),
+            committee_sharing_scheme=AdditiveSharing(share_count=5, modulus=P61),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(5)]
+        for c in clerks:
+            k = c.new_encryption_key()
+            c.upload_agent()
+            c.upload_encryption_key(k)
+        recipient.begin_aggregation(agg.id)
+
+        rng = np.random.default_rng(1)
+        expected = np.zeros(6, dtype=object)
+        for i in range(3):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            # values near the modulus exercise the wide sums
+            vec = rng.integers(P61 - 10, P61, size=6).astype(np.int64)
+            expected = (expected + vec.astype(object)) % P61
+            part.participate(vec, agg.id)
+
+        recipient.end_aggregation(agg.id)
+        members = {
+            c for c, _ in ctx.service.get_committee(recipient.agent, agg.id).clerks_and_keys
+        }
+        for c in [recipient] + clerks:
+            if c.agent.id in members:
+                c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive()
+        np.testing.assert_array_equal(out.values.astype(object), expected)
+
+
+def test_packed_shamir_61bit_host_path():
+    from sda_tpu.crypto import sharing
+
+    p, w2, w3 = find_packed_parameters(3, 4, 8, min_modulus_bits=60, seed=0)
+    assert p > 2**60
+    scheme = PackedShamirSharing(3, 8, 4, p, w2, w3)
+    dim = 7
+    rng = np.random.default_rng(2)
+    s1 = rng.integers(0, p, size=dim).astype(np.int64)
+    s2 = rng.integers(0, p, size=dim).astype(np.int64)
+    gen = sharing.new_share_generator(scheme)
+    combiner = sharing.new_share_combiner(scheme)
+    recon = sharing.new_secret_reconstructor(scheme, dim)
+    sh1, sh2 = gen.generate(s1), gen.generate(s2)
+    combined = [combiner.combine([sh1[c], sh2[c]]) for c in range(8)]
+    indexed = [(i, combined[i]) for i in (0, 2, 3, 4, 5, 6, 7)]  # dropout
+    got = positive(recon.reconstruct(indexed), p)
+    want = np.array(
+        [(int(a) + int(b)) % p for a, b in zip(s1, s2)], dtype=np.int64
+    )
+    np.testing.assert_array_equal(got, want)
