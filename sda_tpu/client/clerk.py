@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from ..crypto import signing
 from ..protocol import ClerkingResult
+from ..utils.metrics import get_metrics
 
 
 class Clerking:
@@ -49,13 +50,18 @@ class Clerking:
         if own_key_id is None:
             raise ValueError("Could not find own encryption key in keyset")
 
+        metrics = get_metrics()
+        metrics.count("clerk.jobs")
+        metrics.count("clerk.participations", len(job.encryptions))
         decryptor = self.crypto.new_share_decryptor(
             own_key_id, aggregation.committee_encryption_scheme
         )
-        share_vectors = decryptor.decrypt_batch(job.encryptions)
+        with metrics.phase("clerk.decrypt"):
+            share_vectors = decryptor.decrypt_batch(job.encryptions)
 
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
-        combined = combiner.combine(share_vectors)
+        with metrics.phase("clerk.combine"):
+            combined = combiner.combine(share_vectors)
 
         # fetch + verify recipient key, re-encrypt the combined vector
         recipient = self.service.get_agent(self.agent, aggregation.recipient)

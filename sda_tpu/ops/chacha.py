@@ -71,6 +71,78 @@ def chacha_blocks(key_words: np.ndarray, first_counter: int, n_blocks: int) -> n
     return x
 
 
+def chacha_blocks_jnp(key_words, first_counter: int, n_blocks: int):
+    """Device twin of ``chacha_blocks``: (n_blocks, 16) uint32 keystream.
+
+    Bit-identical to the numpy implementation (the whole point — mask and
+    re-expansion may run on different backends; see module doc). Vectorized
+    over blocks, so a 100K-dim expansion is ~3K parallel block lanes on the
+    VPU. ``key_words`` may be a traced (8,) uint32 array.
+    """
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+
+    def rotl(x, r):
+        return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+    counters = jnp.arange(first_counter, first_counter + n_blocks, dtype=jnp.uint64)
+    state = jnp.zeros((n_blocks, 16), dtype=jnp.uint32)
+    state = state.at[:, 0:4].set(jnp.asarray(_CONSTANTS))
+    key = jnp.zeros(8, dtype=jnp.uint32).at[: len(key_words)].set(
+        jnp.asarray(key_words, dtype=jnp.uint32)
+    )
+    state = state.at[:, 4:12].set(key)
+    state = state.at[:, 12].set((counters & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+    state = state.at[:, 13].set((counters >> jnp.uint64(32)).astype(jnp.uint32))
+
+    cols = [state[:, i] for i in range(16)]
+    for _ in range(10):
+        for (a, b, c, d) in _QUARTER_ROUNDS:
+            cols[a] = cols[a] + cols[b]
+            cols[d] = rotl(cols[d] ^ cols[a], 16)
+            cols[c] = cols[c] + cols[d]
+            cols[b] = rotl(cols[b] ^ cols[c], 12)
+            cols[a] = cols[a] + cols[b]
+            cols[d] = rotl(cols[d] ^ cols[a], 8)
+            cols[c] = cols[c] + cols[d]
+            cols[b] = rotl(cols[b] ^ cols[c], 7)
+    x = jnp.stack(cols, axis=1) + state
+    return x
+
+
+def expand_seed_jnp(seed_words, dim: int, modulus: int):
+    """Device twin of ``expand_seed``: (dim,) int64 mask in [0, modulus).
+
+    Jittable (static dim): overgenerates blocks with the same slack policy
+    as the host path, applies the same zone rejection, and compacts accepted
+    draws order-preservingly (stable argsort on the rejection mask). The
+    host path extends the stream on rejection-slack exhaustion; at the same
+    consumed-pair count both paths produce identical accepted sequences, so
+    results are bit-identical whenever the slack suffices (probability of
+    exhaustion < 2^-33 per draw — asserted against at test time).
+    """
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+
+    rejection = (1 << 64) % modulus != 0
+    zone = (1 << 64) - ((1 << 64) % modulus)
+    need_pairs = dim + 8  # same slack policy as expand_seed
+    n_blocks = (need_pairs * 2 + 15) // 16
+    words = chacha_blocks_jnp(seed_words, 0, n_blocks).reshape(-1)
+    u64 = (words[0::2].astype(jnp.uint64) << jnp.uint64(32)) | words[1::2].astype(
+        jnp.uint64
+    )
+    if rejection:
+        ok = u64 < jnp.uint64(zone)
+        order = jnp.argsort(~ok, stable=True)  # accepted first, order kept
+        u64 = u64[order]
+    return (u64 % jnp.uint64(modulus)).astype(jnp.int64)[:dim]
+
+
 def expand_seed(seed_words, dim: int, modulus: int) -> np.ndarray:
     """Expand seed u32 words to a dim-length int64 mask in [0, modulus)."""
     if modulus <= 0:

@@ -253,7 +253,57 @@ class TpuAggregator:
             indices = range(self.plan.share_count)
         return reconstruct(sums, indices, self.scheme, self.dim)
 
-    # -- sharded path --------------------------------------------------------
+    # -- sharded paths -------------------------------------------------------
+
+    def sharded_clerk_sums_all_to_all(self):
+        """Clerk-sharded variant: the server-side transpose as an all_to_all.
+
+        Where ``sharded_clerk_sums`` keeps participants sharded and psums
+        per-clerk partials (bandwidth ~ n*B per device, replicated result),
+        this variant physically reshards shares from participant-major to
+        clerk-major over the ``p`` axis — the device-side realization of the
+        snapshot transpose (server/src/snapshot.rs, SURVEY.md §3.2) — and
+        each device then locally sums *all* participants for its own clerk
+        slice. Right when clerks are many (n >= mesh size) and per-clerk
+        downstream work (e.g. sealing results) should stay clerk-local.
+
+        Returns fn(secrets_sharded, key) -> (n, B) clerk sums sharded over
+        ``p`` on the clerk axis.
+        """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        jnp = _jnp()
+        plan = self.plan
+        use_limbs = self.use_limbs
+        modulus = plan.modulus
+        p_size = self.mesh.shape["p"]
+        if plan.share_count % p_size != 0:
+            raise ValueError(
+                f"share_count {plan.share_count} must divide over mesh axis p={p_size}"
+            )
+
+        def local_step(secrets, key):
+            idx = lax.axis_index("p")
+            key = jax.random.fold_in(key, idx)
+            shares = share_participants(secrets, key, plan, use_limbs)  # (Pl, n, B)
+            # reshard: split the clerk axis across "p", gather participants —
+            # afterwards each device holds (P_total_local_group, n/p, B)
+            resharded = lax.all_to_all(
+                shares, "p", split_axis=1, concat_axis=0, tiled=True
+            )
+            local = clerk_combine(resharded)  # (n/p, B) — all participants
+            return lax.rem(local, jnp.int64(modulus))
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(P("p", None), P()),
+            out_specs=P("p", None),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
 
     def sharded_clerk_sums(self):
         """Build the jitted sharded share+combine step over the mesh.

@@ -165,6 +165,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json_option(svc.ping())
             return True
 
+        if method == "GET" and path == "/v1/metrics":
+            # additive observability route (not in the reference protocol)
+            from ..utils.metrics import get_metrics
+
+            self._caller()
+            self._send_json_option(get_metrics().report())
+            return True
+
         if method == "POST" and path == "/v1/agents/me":
             # TOFU: token recorded on successful agent creation (lib.rs:192-201)
             token = self._auth_token()

@@ -13,6 +13,7 @@ import logging
 import uuid
 
 from ..protocol import ClerkingJob, ClerkingJobId, ServerError
+from ..utils.metrics import get_metrics
 
 log = logging.getLogger("sda.server.snapshot")
 
@@ -39,31 +40,36 @@ def run_snapshot(server, snapshot) -> None:
         log.debug("snapshot %s: already exists, retry is a no-op", snapshot.id)
         return
 
+    metrics = get_metrics()
+    metrics.count("snapshots")
     log.debug("snapshot %s: freezing participations", snapshot.id)
-    server.aggregation_store.snapshot_participations(snapshot.aggregation, snapshot.id)
+    with metrics.phase("snapshot.freeze"):
+        server.aggregation_store.snapshot_participations(snapshot.aggregation, snapshot.id)
 
     committee = server.aggregation_store.get_committee(snapshot.aggregation)
     if committee is None:
         raise ServerError("lost committee")
 
     log.debug("snapshot %s: transposing encryptions", snapshot.id)
-    per_clerk = server.aggregation_store.iter_snapshot_clerk_jobs_data(
-        snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
-    )
+    with metrics.phase("snapshot.transpose"):
+        per_clerk = server.aggregation_store.iter_snapshot_clerk_jobs_data(
+            snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
+        )
 
     log.debug("snapshot %s: enqueueing clerking jobs", snapshot.id)
-    for ix, ((clerk_id, _), encryptions) in enumerate(
-        zip(committee.clerks_and_keys, per_clerk)
-    ):
-        server.clerking_job_store.enqueue_clerking_job(
-            ClerkingJob(
-                id=_job_id(snapshot.id, ix),
-                clerk=clerk_id,
-                aggregation=snapshot.aggregation,
-                snapshot=snapshot.id,
-                encryptions=encryptions,
+    with metrics.phase("snapshot.enqueue"):
+        for ix, ((clerk_id, _), encryptions) in enumerate(
+            zip(committee.clerks_and_keys, per_clerk)
+        ):
+            server.clerking_job_store.enqueue_clerking_job(
+                ClerkingJob(
+                    id=_job_id(snapshot.id, ix),
+                    clerk=clerk_id,
+                    aggregation=snapshot.aggregation,
+                    snapshot=snapshot.id,
+                    encryptions=encryptions,
+                )
             )
-        )
 
     if aggregation.masking_scheme.has_mask():
         log.debug("snapshot %s: collecting masking data", snapshot.id)

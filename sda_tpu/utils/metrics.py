@@ -1,0 +1,80 @@
+"""Phase metrics and tracing.
+
+The reference has no timers or counters anywhere (SURVEY.md §5); this is a
+from-scratch aux subsystem: lightweight wall-clock phase timers + counters
+with a process-global registry, used by the server snapshot pipeline, the
+clerk hot path, reveal, and the bench harness. ``jax_trace`` wraps the JAX
+profiler for device-level traces.
+
+Exposed over REST as ``GET /v1/metrics`` (an additive route — the reference
+wire protocol is untouched otherwise).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._timers: dict = {}  # name -> [count, total_s, max_s]
+
+    def count(self, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                entry = self._timers.setdefault(name, [0, 0.0, 0.0])
+                entry[0] += 1
+                entry[1] += dt
+                entry[2] = max(entry[2], dt)
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "phases": {
+                    name: {
+                        "count": c,
+                        "total_s": round(total, 6),
+                        "mean_s": round(total / c, 6) if c else 0.0,
+                        "max_s": round(mx, 6),
+                    }
+                    for name, (c, total, mx) in self._timers.items()
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+
+_GLOBAL = Metrics()
+
+
+def get_metrics() -> Metrics:
+    return _GLOBAL
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: str):
+    """Capture a JAX/XLA device profile (TensorBoard trace format)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -166,6 +166,24 @@ def test_chacha_block_known_vector():
     )
 
 
+def test_chacha_device_bit_identical_to_host():
+    """The TPU-kernel obligation (SURVEY.md §2): mask expansion on device
+    must be bit-identical to the host expansion, or unmasking silently
+    corrupts results."""
+    import jax.numpy as jnp
+
+    for seed in ([1, 2, 3, 4], [0xFFFFFFFF, 7], list(range(8))):
+        seed_np = np.array(seed, dtype=np.uint32)
+        for dim, m in [(1, 433), (100, 433), (1000, (1 << 31) - 1), (257, 2**61 - 1)]:
+            host = chacha.expand_seed(seed_np, dim, m)
+            dev = np.asarray(chacha.expand_seed_jnp(jnp.asarray(seed_np), dim, m))
+            np.testing.assert_array_equal(dev, host, err_msg=f"dim={dim} m={m}")
+    # raw block function parity
+    blocks_host = chacha.chacha_blocks(np.arange(8, dtype=np.uint32), 5, 4)
+    blocks_dev = np.asarray(chacha.chacha_blocks_jnp(jnp.arange(8, dtype=jnp.uint32), 5, 4))
+    np.testing.assert_array_equal(blocks_dev, blocks_host)
+
+
 def test_chacha_expand_deterministic_and_in_range():
     seed = np.array([1, 2, 3, 4], dtype=np.uint32)
     a = chacha.expand_seed(seed, 1000, 433)

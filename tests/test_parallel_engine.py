@@ -151,6 +151,30 @@ def test_sharded_clerk_sums_on_mesh(jax_mods):
     np.testing.assert_array_equal(positive(np.asarray(plain), p), _plain_sum(secrets, p))
 
 
+def test_all_to_all_clerk_sharded_variant(jax_mods):
+    """The transpose-as-all_to_all path: clerk-major resharding must give
+    the same clerk sums as the psum path."""
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator, make_mesh, shard_participants
+    from sda_tpu.parallel.engine import reconstruct
+
+    p = PACKED.prime_modulus
+    dim = 24
+    rng = np.random.default_rng(6)
+    secrets = rng.integers(0, p, size=(16, dim))
+    mesh = make_mesh(p_size=4, d_size=1)  # 8 clerks / 4 devices = 2 each
+    agg = TpuAggregator(PACKED, dim, mesh=mesh)
+    fn = agg.sharded_clerk_sums_all_to_all()
+    sums = fn(shard_participants(jnp.asarray(secrets), mesh), random.key(11))
+    assert sums.shape == (8, dim // 3)
+    out = reconstruct(jnp.asarray(np.asarray(sums)), range(8), PACKED, dim)
+    np.testing.assert_array_equal(
+        positive(np.asarray(out), p), _plain_sum(secrets, p)
+    )
+
+
 def test_sharded_matches_engine_across_mesh_shapes(jax_mods):
     import jax.numpy as jnp
     from jax import random

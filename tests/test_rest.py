@@ -83,6 +83,22 @@ def test_full_loop_over_http(http_ctx):
     )
 
 
+def test_metrics_route(http_ctx):
+    _, base_url, tmp_path = http_ctx
+    service = SdaHttpClient(base_url, TokenStore(tmp_path / "m"))
+    alice = new_client(tmp_path / "alice-m", service)
+    alice.upload_agent()
+    resp = requests.get(
+        f"{base_url}/v1/metrics",
+        auth=(str(alice.agent.id), TokenStore(tmp_path / "m").get()),
+    )
+    assert resp.status_code == 200
+    body = resp.json()
+    assert "counters" in body and "phases" in body
+    # unauthenticated -> 401
+    assert requests.get(f"{base_url}/v1/metrics").status_code == 401
+
+
 def test_auth_and_error_mapping(http_ctx):
     _, base_url, tmp_path = http_ctx
     service = SdaHttpClient(base_url, TokenStore(tmp_path / "a"))
