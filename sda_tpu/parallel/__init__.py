@@ -6,6 +6,7 @@ MXU mod-p matmul.
 
 from .engine import AggregationPlan, TpuAggregator, full_training_step, make_plan
 from .mesh import make_mesh, shard_participants
+from .sumfirst import clerk_sums_sum_first
 
 __all__ = [
     "TpuAggregator",
@@ -14,4 +15,5 @@ __all__ = [
     "full_training_step",
     "make_mesh",
     "shard_participants",
+    "clerk_sums_sum_first",
 ]

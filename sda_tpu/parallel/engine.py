@@ -207,12 +207,9 @@ def reconstruct(clerk_sums, indices, scheme, dim: int):
     p = scheme.prime_modulus
     if p >= (1 << 31):
         # wide modulus: tiny matrices, exact host interpolation
-        import numpy as np
-
-        L = shamir.reconstruction_matrix(scheme, list(indices))  # (k, R)
-        rows = np.asarray(clerk_sums)[list(indices)]  # (R, B)
-        secrets = shamir.reconstruct_batches(rows.T, L, p)  # (B, k)
-        return jnp.asarray(secrets.reshape(-1)[:dim])
+        return jnp.asarray(
+            shamir.reconstruct_clerk_sums_host(clerk_sums, indices, scheme, dim)
+        )
     L = jnp.asarray(shamir.reconstruction_matrix(scheme, list(indices)))  # (k, R)
     rows = clerk_sums[jnp.asarray(list(indices))]  # (R, B)
     prods = lax.rem(L[:, :, None] * rows[None, :, :], jnp.int64(p))

@@ -1,0 +1,136 @@
+"""Sum-first clerk sums: ``share(Σ_c v_c) = Σ_c share(v_c)`` (linearity).
+
+Packed-Shamir share generation is a fixed linear map ``v ↦ v @ S`` over the
+prime field (ops/shamir.py), and the clerk's job is the *sum* of all
+participants' shares (reference: client/src/clerk.rs:85-86,
+client/src/crypto/sharing/combiner.rs:16-30). Matmul and participant-sum
+commute, so when the fabric's goal is the clerk sums themselves — the
+co-hosted/simulated-participant setting the TPU aggregation fabric exists
+for (SURVEY.md §2.3) — the hot loop over the big ``(participants, dim)``
+tensor reduces to one streaming integer reduction, and the share matmul
+runs once on the tiny ``(B, K)`` participant-sum. Bit-exact: both orders
+compute the same field elements.
+
+Do NOT use this path when individual participants' shares must exist —
+e.g. to be sealed per clerk for transport (the real multi-party protocol
+plane, client/participate.py); that's ``engine.share_participants``.
+
+Overflow discipline: the reduction is carried as *exact integer* sums in
+base-2³² limb space — no mod ops touch the big tensor at all. Canonical
+values ``v < p < 2⁶²`` split into ``lo = v & (2³²−1)`` and ``hi = v ≫ 32``;
+limb sums over ``C_total`` participants are bounded by ``C_total · (2³²−1)``,
+so int64 accumulators are exact for up to 2³¹ participants (2048× the 1M
+north star). For ``p < 2³¹`` a single limb suffices. The epilogue
+(recombine mod p + share matmul) runs host-side with exact python-int
+arithmetic on the tiny accumulator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import shamir
+from ..ops.jaxcfg import ensure_x64
+from ..ops.modular import modmatmul_np
+from .engine import AggregationPlan, _batch_secrets, _device_randomness
+
+#: participant bound for exact int64 limb accumulation (see module doc)
+MAX_PARTICIPANTS = 1 << 31
+
+
+def limb_count_sum(p: int) -> int:
+    """Limbs needed for exact base-2^32 sum accumulation of values < p."""
+    return 1 if p <= (1 << 31) else 2
+
+
+def value_limb_sums_chunk(secrets, key, plan: AggregationPlan, draw=None):
+    """One streaming chunk of the sum-first hot loop.
+
+    ``(C, dim)`` canonical secrets -> ``(L, B, K)`` int64 *exact integer*
+    limb sums over the chunk's participants of the per-participant value
+    rows ``[batched secrets | fresh randomness]`` (the same rows
+    ``engine.share_participants`` feeds the share matmul). ``L`` is
+    ``limb_count_sum(p)``. Accumulate chunks with plain ``+`` — no mod ops —
+    while total participants stay below ``MAX_PARTICIPANTS``.
+
+    Secrets and randomness are limb-summed separately and joined on the
+    tiny ``(B, ·)`` results — the big ``(C, B, K)`` concatenation the share
+    matmul needs never materializes. ``draw(key, shape, p) -> int64 in
+    [0, p)`` overrides the randomness generator (the benchmark passes a
+    division-free masked-bits draw; default is the simulation-grade
+    ``uniform_mod_device``, which keeps this bit-identical to
+    ``share_participants`` for the same key).
+    """
+    ensure_x64()
+    import jax.numpy as jnp
+
+    p = plan.modulus
+    batches = _batch_secrets(secrets, plan)  # (C, b, k)
+    C, nb = batches.shape[0], batches.shape[1]
+    if draw is None:
+        draw = _device_randomness
+    randomness = draw(key, (C, nb, plan.rand_size), p)
+
+    def limb_sums(x):  # (C, b, cols) -> (L, b, cols) exact integer sums
+        x = x.astype(jnp.int64)
+        if limb_count_sum(p) == 1:
+            return jnp.sum(x, axis=0)[None]
+        mask = jnp.int64(0xFFFFFFFF)
+        return jnp.stack(
+            [jnp.sum(x & mask, axis=0), jnp.sum(x >> jnp.int64(32), axis=0)]
+        )
+
+    return jnp.concatenate([limb_sums(batches), limb_sums(randomness)], axis=-1)
+
+
+def exact_value_sums(limb_acc):
+    """``(L, B, K)`` int64 limb accumulator -> ``(B, K)`` exact integer
+    participant sums (object dtype, python ints — no modulus applied)."""
+    acc = np.asarray(limb_acc, dtype=object)
+    out = np.zeros(acc.shape[1:], dtype=object)
+    for w in range(acc.shape[0]):
+        out = out + acc[w] * (1 << (32 * w))
+    return out
+
+
+def clerk_sums_from_limb_acc(limb_acc, plan: AggregationPlan, exact=None):
+    """Host epilogue: ``(L, B, K)`` int64 limb accumulator -> clerk sums.
+
+    Returns ``(clerk_sums, value_sums)``: ``clerk_sums`` is the ``(n, B)``
+    int64 canonical per-clerk share sums (exactly what per-participant
+    sharing + clerk-combine produces), ``value_sums`` the ``(B, K)``
+    canonical participant-sums (whose first ``k`` columns are the plain
+    batched secret sums — the free verification handle). All arithmetic on
+    this tiny accumulator is exact python-int / object-dtype. Pass a
+    precomputed ``exact_value_sums(limb_acc)`` as ``exact`` to reuse it.
+    """
+    p = plan.modulus
+    if exact is None:
+        exact = exact_value_sums(limb_acc)
+    vsum = exact % p  # exact sums >= 0: % == canonical rem
+    if plan.share_matrix is None:
+        raise ValueError("sum-first epilogue requires a packed share matrix")
+    S_T = plan.share_matrix.T.astype(np.int64)  # (K, n)
+    clerk = modmatmul_np(vsum, S_T, p)  # (B, n) in (-p, p)
+    clerk = np.where(clerk < 0, clerk + p, clerk).astype(np.int64)
+    return clerk.T.copy(), vsum.astype(np.int64)
+
+
+def clerk_sums_sum_first(secrets, key, plan: AggregationPlan):
+    """Single-shot convenience: ``(P, dim)`` -> ``(n, B)`` clerk sums.
+
+    Parity twin of ``share_participants`` + ``clerk_combine`` + rem (see
+    tests/test_parallel_engine.py); the streaming bench drives the chunk /
+    epilogue pieces directly.
+    """
+    if secrets.shape[0] > MAX_PARTICIPANTS:
+        raise ValueError(f"chunk the input: exact bound is {MAX_PARTICIPANTS}")
+    acc = value_limb_sums_chunk(secrets, key, plan)
+    clerk, _ = clerk_sums_from_limb_acc(np.asarray(acc), plan)
+    return clerk
+
+
+def reconstruct_from_clerk_sums(clerk_sums, indices, scheme, dim: int):
+    """Host-exact reconstruction for any modulus width (tiny inputs; the
+    bench epilogue). Same helper backs ``engine.reconstruct``'s wide path."""
+    return shamir.reconstruct_clerk_sums_host(clerk_sums, indices, scheme, dim)
