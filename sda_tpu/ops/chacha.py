@@ -71,6 +71,61 @@ def chacha_blocks(key_words: np.ndarray, first_counter: int, n_blocks: int) -> n
     return x
 
 
+def chacha_state_jnp(key_words, first_counter: int, n_blocks: int):
+    """Initial ChaCha20 states: (n_blocks, 16) uint32 (pre-round input).
+
+    Shared by the jnp round loop and the Pallas kernel (chacha_pallas.py) so
+    every backend starts from identical bits. ``key_words`` may be a traced
+    (8,) uint32 array.
+    """
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+
+    counters = jnp.arange(first_counter, first_counter + n_blocks, dtype=jnp.uint64)
+    state = jnp.zeros((n_blocks, 16), dtype=jnp.uint32)
+    state = state.at[:, 0:4].set(jnp.asarray(_CONSTANTS))
+    key = jnp.zeros(8, dtype=jnp.uint32).at[: len(key_words)].set(
+        jnp.asarray(key_words, dtype=jnp.uint32)
+    )
+    state = state.at[:, 4:12].set(key)
+    state = state.at[:, 12].set((counters & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+    state = state.at[:, 13].set((counters >> jnp.uint64(32)).astype(jnp.uint32))
+    return state
+
+
+def apply_rounds_jnp(cols):
+    """The 20 ChaCha rounds on a 16-list of uint32 jnp arrays (no
+    feed-forward). Single source of the round body for every traced path —
+    the jnp twin and the Pallas kernel both call this; only the numpy host
+    implementation above stays independent, as the cross-check reference."""
+    import jax.numpy as jnp
+
+    def rotl(x, r):
+        return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+    for _ in range(10):  # 20 rounds = 10 double rounds
+        for (a, b, c, d) in _QUARTER_ROUNDS:
+            cols[a] = cols[a] + cols[b]
+            cols[d] = rotl(cols[d] ^ cols[a], 16)
+            cols[c] = cols[c] + cols[d]
+            cols[b] = rotl(cols[b] ^ cols[c], 12)
+            cols[a] = cols[a] + cols[b]
+            cols[d] = rotl(cols[d] ^ cols[a], 8)
+            cols[c] = cols[c] + cols[d]
+            cols[b] = rotl(cols[b] ^ cols[c], 7)
+    return cols
+
+
+def chacha_rounds_jnp(state):
+    """20 ChaCha rounds + feed-forward on ``(..., 16)`` uint32 states."""
+    import jax.numpy as jnp
+
+    cols = apply_rounds_jnp([state[..., i] for i in range(16)])
+    return jnp.stack(cols, axis=-1) + state
+
+
 def chacha_blocks_jnp(key_words, first_counter: int, n_blocks: int):
     """Device twin of ``chacha_blocks``: (n_blocks, 16) uint32 keystream.
 
@@ -82,65 +137,24 @@ def chacha_blocks_jnp(key_words, first_counter: int, n_blocks: int):
     from .jaxcfg import ensure_x64
 
     ensure_x64()
-    import jax.numpy as jnp
-
-    def rotl(x, r):
-        return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
-
-    counters = jnp.arange(first_counter, first_counter + n_blocks, dtype=jnp.uint64)
-    state = jnp.zeros((n_blocks, 16), dtype=jnp.uint32)
-    state = state.at[:, 0:4].set(jnp.asarray(_CONSTANTS))
-    key = jnp.zeros(8, dtype=jnp.uint32).at[: len(key_words)].set(
-        jnp.asarray(key_words, dtype=jnp.uint32)
-    )
-    state = state.at[:, 4:12].set(key)
-    state = state.at[:, 12].set((counters & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
-    state = state.at[:, 13].set((counters >> jnp.uint64(32)).astype(jnp.uint32))
-
-    cols = [state[:, i] for i in range(16)]
-    for _ in range(10):
-        for (a, b, c, d) in _QUARTER_ROUNDS:
-            cols[a] = cols[a] + cols[b]
-            cols[d] = rotl(cols[d] ^ cols[a], 16)
-            cols[c] = cols[c] + cols[d]
-            cols[b] = rotl(cols[b] ^ cols[c], 12)
-            cols[a] = cols[a] + cols[b]
-            cols[d] = rotl(cols[d] ^ cols[a], 8)
-            cols[c] = cols[c] + cols[d]
-            cols[b] = rotl(cols[b] ^ cols[c], 7)
-    x = jnp.stack(cols, axis=1) + state
-    return x
+    return chacha_rounds_jnp(chacha_state_jnp(key_words, first_counter, n_blocks))
 
 
 def expand_seed_jnp(seed_words, dim: int, modulus: int):
     """Device twin of ``expand_seed``: (dim,) int64 mask in [0, modulus).
 
-    Jittable (static dim): overgenerates blocks with the same slack policy
-    as the host path, applies the same zone rejection, and compacts accepted
-    draws order-preservingly (stable argsort on the rejection mask). The
-    host path extends the stream on rejection-slack exhaustion; at the same
-    consumed-pair count both paths produce identical accepted sequences, so
-    results are bit-identical whenever the slack suffices (probability of
-    exhaustion < 2^-33 per draw — asserted against at test time).
+    Eager-mode (the window guard reads a device scalar): delegates to the
+    batched expansion (chacha_pallas.expand_seeds_batch) with P=1 — same
+    zone rejection and draw order as the host path, with a q-scaled
+    overgenerated window and a ``SlackExhausted`` guard instead of wrong
+    bits. Bit-identical to ``expand_seed`` (asserted at test time).
     """
-    from .jaxcfg import ensure_x64
-
-    ensure_x64()
     import jax.numpy as jnp
 
-    rejection = (1 << 64) % modulus != 0
-    zone = (1 << 64) - ((1 << 64) % modulus)
-    need_pairs = dim + 8  # same slack policy as expand_seed
-    n_blocks = (need_pairs * 2 + 15) // 16
-    words = chacha_blocks_jnp(seed_words, 0, n_blocks).reshape(-1)
-    u64 = (words[0::2].astype(jnp.uint64) << jnp.uint64(32)) | words[1::2].astype(
-        jnp.uint64
-    )
-    if rejection:
-        ok = u64 < jnp.uint64(zone)
-        order = jnp.argsort(~ok, stable=True)  # accepted first, order kept
-        u64 = u64[order]
-    return (u64 % jnp.uint64(modulus)).astype(jnp.int64)[:dim]
+    from .chacha_pallas import expand_seeds_batch
+
+    seeds = jnp.asarray(seed_words, dtype=jnp.uint32)[None, :]
+    return expand_seeds_batch(seeds, dim, modulus, backend="jnp")[0]
 
 
 def expand_seed(seed_words, dim: int, modulus: int) -> np.ndarray:

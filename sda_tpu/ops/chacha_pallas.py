@@ -1,0 +1,253 @@
+"""Pallas TPU kernel for ChaCha20 keystream expansion.
+
+The ChaCha masking scheme (crypto/masking.py; reference:
+client/src/crypto/masking/chacha.rs) makes the *recipient* re-expand every
+participant's seed to a dim-length mask at reveal time — for 1M
+participants x 100K dims that is ~3e9 ChaCha blocks, the single biggest
+VPU-bound workload in the system (reference hot loop:
+client/src/receive.rs:102-118 + chacha.rs:56-77). The jnp twin
+(ops/chacha.py) is correct but materializes 16 full word tensors between
+every one of the 80 quarter rounds, bouncing through HBM; this kernel keeps
+the whole 16-word state in VMEM/registers for all 20 rounds and touches HBM
+exactly twice per block (load initial state, store keystream).
+
+Layout: states are carried as ``(16, n_blocks)`` uint32 — one word per
+sublane row, blocks along the 128-wide lane axis — so every quarter-round
+op is a full-width VPU op on ``(tile,)`` lanes. The grid tiles the block
+axis; each kernel instance processes ``tile`` blocks independently (ChaCha
+blocks share no state). Multi-seed batches flatten (seeds x blocks) onto
+the same lane axis — one kernel launch expands every participant's stream.
+
+Bit parity: every path (numpy host, jnp, Pallas) runs the same djb quarter
+round over states from the one state builder (``chacha_state_jnp``), so
+outputs are bit-identical — asserted in tests/test_ops_field.py on the
+interpreter and (when available) on real TPU. ``ChaChaMasker.combine``
+(crypto/masking.py) dispatches here for large reveal batches and falls back
+to the host loop when no accelerator path is usable.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .chacha import apply_rounds_jnp, chacha_rounds_jnp, chacha_state_jnp
+
+# lane-axis tile: 512 blocks x 16 words x 4 B x 2 (in+out) = 64 KiB of VMEM
+_TILE = 512
+
+
+def _rounds_kernel(state_ref, out_ref):
+    init = [state_ref[i, :] for i in range(16)]
+    # fully unrolled inside the kernel; round body shared with the jnp twin
+    x = apply_rounds_jnp(list(init))
+    for i in range(16):
+        out_ref[i, :] = x[i] + init[i]
+
+
+def _rounds_pallas(states, *, interpret: bool = False):
+    """(N, 16) uint32 initial states -> (N, 16) keystream via the kernel."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    n = states.shape[0]
+    padded = max(-(-n // _TILE), 1) * _TILE
+    st = jnp.zeros((16, padded), dtype=jnp.uint32).at[:, :n].set(states.T)
+    out = pl.pallas_call(
+        _rounds_kernel,
+        grid=(padded // _TILE,),
+        in_specs=[pl.BlockSpec((16, _TILE), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((16, _TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((16, padded), jnp.uint32),
+        interpret=interpret,
+    )(st)
+    return out[:, :n].T
+
+
+def chacha_blocks_pallas(
+    key_words, first_counter: int, n_blocks: int, *, interpret: bool = False
+):
+    """Pallas twin of ``chacha_blocks``: (n_blocks, 16) uint32 keystream."""
+    state = chacha_state_jnp(key_words, first_counter, n_blocks)
+    return _rounds_pallas(state, interpret=interpret)
+
+
+#: probe cache: None = not yet probed; True/False = cached for the process
+_PALLAS_OK: bool | None = None
+
+
+def pallas_available() -> bool:
+    """Can this backend run the compiled kernel? (CPU meshes and the
+    interpreter don't count — they'd be slower than the jnp twin.)
+
+    Probed lazily on first use (the jax backend is already initialized by
+    then — ``ensure_x64`` ran) and cached for the process either way, with
+    exactly one log line on failure: re-probing would re-trace a failed
+    pallas_call per chunk (~1000 redundant compile attempts per large
+    reveal on a backend without Pallas). A kernel that *runs but produces
+    wrong bits* is logged as an error — it would otherwise corrupt masks
+    silently.
+    """
+    global _PALLAS_OK
+    if _PALLAS_OK is not None:
+        return _PALLAS_OK
+    import numpy as np
+
+    log = logging.getLogger(__name__)
+    try:
+        import jax.numpy as jnp
+
+        got = np.asarray(chacha_blocks_pallas(jnp.arange(8, dtype=jnp.uint32), 0, 1))
+        from .chacha import chacha_blocks
+
+        ok = bool(np.array_equal(got, chacha_blocks(np.arange(8), 0, 1)))
+        if not ok:
+            log.error("Pallas ChaCha kernel produced wrong bits; disabled for process")
+    except Exception as e:
+        log.warning(
+            "Pallas ChaCha unavailable (%s: %s); using jnp rounds for process",
+            type(e).__name__,
+            e,
+        )
+        ok = False
+    _PALLAS_OK = ok
+    return ok
+
+
+def _rounds(states, backend: str):
+    """Dispatch ``(N, 16) -> (N, 16)`` rounds by backend name.
+
+    ``auto`` = compiled Pallas kernel when the backend supports it, else the
+    jnp twin; ``pallas`` / ``interpret`` / ``jnp`` force a specific path
+    (interpret = Pallas interpreter, for CPU tests of the kernel source).
+    """
+    if backend == "auto":
+        backend = "pallas" if pallas_available() else "jnp"
+    if backend == "pallas":
+        return _rounds_pallas(states)
+    if backend == "interpret":
+        return _rounds_pallas(states, interpret=True)
+    if backend == "jnp":
+        return chacha_rounds_jnp(states)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+class SlackExhausted(RuntimeError):
+    """A seed's keystream window held fewer than ``dim`` accepted draws.
+
+    ~1e-9 per *row* (6-sigma margin), so order 1e-3 per 1M-row reveal;
+    ``combine_masks_device`` recovers by host-expanding only the affected
+    chunk — the penalty is bounded, never a full host re-run."""
+
+
+def _window_pairs(dim: int, modulus: int) -> int:
+    """How many u64 pairs to generate so every row holds >= dim accepted
+    draws with ~6-sigma margin.
+
+    The accepted sequence is a deterministic prefix-filter of the keystream
+    (first ``dim`` pairs below the rejection zone, in stream order), so
+    overgeneration never changes results — the host path (expand_seed)
+    produces the identical sequence by extending the stream on demand.
+    Rejection probability ``q = (2^64 mod m) / 2^64`` reaches ~12.5% for a
+    prime just above a power of two, so the window must scale with q, not
+    use a fixed slack."""
+    q = ((1 << 64) % modulus) / float(1 << 64)
+    if q == 0.0:
+        return dim
+    import math
+
+    expected = dim / (1.0 - q)
+    margin = 6.0 * math.sqrt(expected * q) / (1.0 - q)
+    return dim + int(expected - dim + margin) + 8
+
+
+def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "auto"):
+    """(P, w<=8) uint32 seeds -> (P, dim) int64 masks, all on device at once.
+
+    Batched twin of ``ops.chacha.expand_seed``: identical zone rejection and
+    per-seed draw order (stable compaction along the pair axis) over a
+    q-scaled overgenerated window (``_window_pairs``) — bit-equal to the
+    host path row by row. If a row still holds fewer than ``dim`` accepted
+    draws (~1e-9 per batch), raises ``SlackExhausted`` rather than return
+    wrong bits; eager-mode only for that reason (the guard reads a device
+    scalar). One flat kernel launch covers all P keystreams. ``backend``
+    as in ``_rounds``; ``ops.chacha.expand_seed_jnp`` is this with P=1.
+    """
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax
+    import jax.numpy as jnp
+
+    seed_words = jnp.asarray(seed_words, dtype=jnp.uint32)
+    P = seed_words.shape[0]
+    if P == 0:
+        return jnp.zeros((0, dim), dtype=jnp.int64)
+    rejection = (1 << 64) % modulus != 0
+    zone = (1 << 64) - ((1 << 64) % modulus)
+    need_pairs = _window_pairs(dim, modulus)
+    n_blocks = (need_pairs * 2 + 15) // 16
+    states = jax.vmap(lambda s: chacha_state_jnp(s, 0, n_blocks))(seed_words)
+    words = _rounds(states.reshape(P * n_blocks, 16), backend)
+    words = words.reshape(P, n_blocks * 16)
+    u64 = (words[:, 0::2].astype(jnp.uint64) << jnp.uint64(32)) | words[:, 1::2].astype(
+        jnp.uint64
+    )
+    if rejection:
+        ok = u64 < jnp.uint64(zone)
+        if int(jnp.sum(ok, axis=1).min()) < dim:
+            raise SlackExhausted(
+                f"seed window of {u64.shape[1]} pairs held < {dim} accepted draws"
+            )
+        order = jnp.argsort(~ok, axis=1, stable=True)  # accepted first, order kept
+        u64 = jnp.take_along_axis(u64, order, axis=1)
+    return (u64 % jnp.uint64(modulus)).astype(jnp.int64)[:, :dim]
+
+
+#: transient device-memory budget per fold of combine_masks_device; the
+#: expansion materializes ~5 chunk x dim x 8 B tensors at peak (u64 pairs,
+#: rejection mask, argsort indices, gathered pairs, final masks)
+_COMBINE_BYTES_BUDGET = 2 << 30
+
+
+def combine_masks_device(seed_words, dim: int, modulus: int, *, chunk: int | None = None):
+    """Recipient reveal hot loop on device: Σ_p expand(seed_p) mod m.
+
+    (P, w) uint32 seeds -> (dim,) int64 combined mask — the ChaCha
+    ``SecretUnmasker``'s inner sum (reference chacha.rs:56-77) as a device
+    computation, folding ``chunk`` seeds at a time. The default chunk is
+    sized so one fold's ~5 transient chunk x dim x 8 B tensors fit in
+    ``_COMBINE_BYTES_BUDGET`` (e.g. dim=100K -> chunk ~ 1K folds of ~2 GB),
+    so the headline 1M x 100K reveal streams instead of OOMing.
+    """
+    from .jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .modular import mod_sum_wide_jnp
+
+    if chunk is None:
+        chunk = max(16, _COMBINE_BYTES_BUDGET // (5 * 8 * dim))
+    seed_words = np.asarray(seed_words, dtype=np.uint32)
+    total = jnp.zeros((dim,), dtype=jnp.int64)
+    for start in range(0, seed_words.shape[0], chunk):
+        batch = seed_words[start : start + chunk]
+        try:
+            masks = expand_seeds_batch(jnp.asarray(batch), dim, modulus)
+        except SlackExhausted:
+            # ~1e-9-per-row event: host-expand just this chunk (the host
+            # path extends the stream on demand) and keep the device fold
+            from .chacha import expand_seed
+
+            logging.getLogger(__name__).info(
+                "rejection slack exhausted in chunk at %d; host-expanding it", start
+            )
+            masks = jnp.asarray(np.stack([expand_seed(s, dim, modulus) for s in batch]))
+        if modulus <= (1 << 31):
+            part = jnp.sum(masks, axis=0) % jnp.int64(modulus)
+        else:
+            part = mod_sum_wide_jnp(masks, modulus, axis=0)
+        total = (total + part) % jnp.int64(modulus)
+    return total
