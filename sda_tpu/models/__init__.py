@@ -1,0 +1,28 @@
+"""Model-facing layer: federated learning over secure aggregation.
+
+The reference's stated purpose is combining locally trained ML models
+from phones into one global model without revealing any individual model
+(reference README.md:5-15) — but it ships only the integer-vector
+protocol and leaves the model plumbing to the application. This package
+closes that gap for JAX models: pytree flattening, fixed-point
+quantization into the aggregation's prime field, and a FedAvg round
+driver over any ``SdaService``.
+"""
+
+from .federated import (
+    FederatedAveraging,
+    QuantizationSpec,
+    dequantize_mean,
+    flatten_pytree,
+    quantize_update,
+    unflatten_pytree,
+)
+
+__all__ = [
+    "FederatedAveraging",
+    "QuantizationSpec",
+    "dequantize_mean",
+    "flatten_pytree",
+    "quantize_update",
+    "unflatten_pytree",
+]

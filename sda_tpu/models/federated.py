@@ -1,0 +1,261 @@
+"""Federated averaging of JAX model pytrees over secure aggregation.
+
+The protocol plane aggregates integer vectors mod p; models are float
+pytrees. This module is the bridge, in three layers:
+
+1. **Pytree <-> flat vector**: ``flatten_pytree`` / ``unflatten_pytree``
+   give a stable leaf order (jax tree flattening) so every participant
+   quantizes the same coordinate layout.
+2. **Fixed-point field encoding**: ``QuantizationSpec`` maps floats to
+   the prime field symmetrically — ``q = round(x * 2^frac_bits) mod p``,
+   negative values as high residues. The field must hold the *sum* of
+   all participants' values without wrapping, so the spec checks
+   ``n_participants * 2^frac_bits * clip < p / 2`` — the same
+   "values must fit" discipline the reference documents for its i64
+   plane (client/src/crypto/sharing/additive.rs:37-39), promoted to a
+   hard precondition instead of a comment.
+3. **Round driver**: ``FederatedAveraging`` runs one FedAvg round
+   end-to-end over any ``SdaService``: the recipient opens an
+   aggregation sized to the flattened model; each participant uploads
+   its quantized update through the full crypto pipeline (mask, share,
+   seal — client/participate.py); reveal returns the *mean* update,
+   dequantized back into the original pytree structure. No party —
+   server, clerks, or recipient — ever sees an individual model.
+
+The aggregate is exact in the field: quantization is the only lossy
+step, and its error is bounded by ``n / 2^(frac_bits+1)`` per
+coordinate of the sum. Everything downstream (sharing, clerking,
+reconstruction) is bit-exact integer math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops.modular import positive
+
+
+def flatten_pytree(tree):
+    """pytree of arrays -> ((dim,) float64 vector, treedef, shapes)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.asarray(leaf, dtype=np.float64) for leaf in leaves]
+    shapes = [a.shape for a in arrs]
+    flat = (
+        np.concatenate([a.reshape(-1) for a in arrs])
+        if arrs
+        else np.empty(0, dtype=np.float64)
+    )
+    return flat, treedef, shapes
+
+
+def unflatten_pytree(flat, treedef, shapes):
+    """Inverse of ``flatten_pytree`` (float64 leaves)."""
+    import jax
+
+    leaves = []
+    offset = 0
+    for shape in shapes:
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        leaves.append(np.asarray(flat[offset : offset + size]).reshape(shape))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@dataclass(frozen=True)
+class QuantizationSpec:
+    """Symmetric fixed-point encoding of floats into the prime field.
+
+    ``frac_bits`` fractional bits; ``clip`` bounds each coordinate's
+    magnitude (values are clamped); ``n_participants`` is the maximum
+    number of summed updates the field must hold without wraparound.
+    """
+
+    modulus: int
+    frac_bits: int
+    clip: float
+    n_participants: int
+
+    def __post_init__(self):
+        bound = self.n_participants * self.scale * self.clip
+        if not bound < (self.modulus - 1) // 2:
+            raise ValueError(
+                f"field too small: {self.n_participants} participants x "
+                f"2^{self.frac_bits} x clip={self.clip} needs modulus > "
+                f"{int(2 * bound) + 1}, have {self.modulus}"
+            )
+
+    @property
+    def scale(self) -> int:
+        return 1 << self.frac_bits
+
+    @classmethod
+    def fitted(
+        cls,
+        frac_bits: int,
+        clip: float,
+        n_participants: int,
+        *,
+        secret_count: int = 5,
+        privacy_threshold: int = 2,
+        share_count: int = 8,
+    ):
+        """Generate a field just large enough plus its sharing scheme.
+
+        Returns ``(spec, PackedShamirSharing)``: the prime is found with
+        ``find_packed_parameters`` at the minimal bit width that holds
+        ``n_participants`` summed updates without wraparound, so the two
+        halves (quantization and sharing) are guaranteed consistent.
+        """
+        import math
+
+        from ..ops import find_packed_parameters
+        from ..protocol import PackedShamirSharing
+
+        need = 2.0 * n_participants * (1 << frac_bits) * clip
+        bits = max(16, math.ceil(math.log2(need)) + 1)
+        if bits > 61:
+            raise ValueError(f"required field width {bits} bits exceeds 61")
+        p, w2, w3 = find_packed_parameters(
+            secret_count, privacy_threshold, share_count, min_modulus_bits=bits
+        )
+        scheme = PackedShamirSharing(
+            secret_count=secret_count,
+            share_count=share_count,
+            privacy_threshold=privacy_threshold,
+            prime_modulus=p,
+            omega_secrets=w2,
+            omega_shares=w3,
+        )
+        return cls(p, frac_bits, clip, n_participants), scheme
+
+    def quantize(self, flat: np.ndarray) -> np.ndarray:
+        """float vector -> field elements in [0, p): round-to-nearest
+        fixed point, negatives as high residues."""
+        clipped = np.clip(np.asarray(flat, dtype=np.float64), -self.clip, self.clip)
+        q = np.rint(clipped * self.scale).astype(np.int64)
+        return positive(q, self.modulus)
+
+    def dequantize_sum(self, field_sum: np.ndarray) -> np.ndarray:
+        """Revealed field sum -> float vector of the *sum* of updates.
+
+        Centered lift: residues above p/2 are the negative range. Valid
+        because the precondition bounds |sum| < p/2."""
+        v = np.asarray(field_sum, dtype=np.int64)
+        half = self.modulus // 2
+        centered = np.where(v > half, v - self.modulus, v)
+        return centered.astype(np.float64) / self.scale
+
+
+def quantize_update(tree, spec: QuantizationSpec):
+    """Model pytree -> (field vector, treedef, shapes) for participation."""
+    flat, treedef, shapes = flatten_pytree(tree)
+    return spec.quantize(flat), treedef, shapes
+
+
+def dequantize_mean(field_sum, n: int, spec: QuantizationSpec, treedef, shapes):
+    """Revealed field sum of n updates -> mean-update pytree."""
+    return unflatten_pytree(spec.dequantize_sum(field_sum) / n, treedef, shapes)
+
+
+class FederatedAveraging:
+    """One secure FedAvg round over any ``SdaService``.
+
+    The recipient side (``open_round`` / ``finish_round``) and the
+    participant side (``submit_update``) are separate methods because in
+    a real deployment they run on different machines; the only shared
+    state is the aggregation id on the wire. ``spec.n_participants`` is
+    the *capacity* bound (wraparound safety); fewer may actually submit
+    — the mean divides by the real count.
+    """
+
+    def __init__(self, spec: QuantizationSpec, template_tree):
+        flat, treedef, shapes = flatten_pytree(template_tree)
+        self.spec = spec
+        self.treedef = treedef
+        self.shapes = shapes
+        self.dim = int(flat.size)
+
+    def open_round(
+        self,
+        recipient,
+        recipient_key,
+        committee_sharing_scheme,
+        *,
+        title: str = "federated-round",
+        masking_scheme=None,
+    ):
+        """Recipient: create + begin an aggregation sized to the model.
+
+        ``committee_sharing_scheme`` comes from ``QuantizationSpec.fitted``
+        (which guarantees its field matches ``spec``) or is hand-built;
+        a modulus mismatch with the spec is rejected. Default masking is
+        ChaCha (seed-compressed). Returns the aggregation id.
+        """
+        from ..protocol import (
+            Aggregation,
+            AggregationId,
+            ChaChaMasking,
+            SodiumEncryptionScheme,
+        )
+
+        scheme_mod = getattr(
+            committee_sharing_scheme, "prime_modulus", None
+        ) or getattr(committee_sharing_scheme, "modulus", None)
+        if scheme_mod != self.spec.modulus:
+            raise ValueError(
+                f"sharing scheme field {scheme_mod} != quantization field "
+                f"{self.spec.modulus}"
+            )
+        if masking_scheme is None:
+            masking_scheme = ChaChaMasking(
+                modulus=self.spec.modulus, dimension=self.dim, seed_bitsize=128
+            )
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title=title,
+            vector_dimension=self.dim,
+            modulus=self.spec.modulus,
+            recipient=recipient.agent.id,
+            recipient_key=recipient_key,
+            masking_scheme=masking_scheme,
+            committee_sharing_scheme=committee_sharing_scheme,
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        return agg.id
+
+    def submit_update(self, participant, aggregation_id, update_tree):
+        """Participant: quantize a local update and run full participation."""
+        field_vec, treedef, shapes = flatten_pytree(update_tree)
+        if treedef != self.treedef:
+            raise ValueError("update pytree structure differs from template")
+        if shapes != self.shapes:
+            # same treedef + same total size can still misalign coordinates
+            # (e.g. a transposed weight matrix) — reject, don't corrupt
+            raise ValueError(
+                f"update leaf shapes {shapes} differ from template {self.shapes}"
+            )
+        participant.participate(
+            self.spec.quantize(field_vec).tolist(), aggregation_id
+        )
+
+    def close_round(self, recipient, aggregation_id):
+        """Recipient: freeze participations + enqueue clerking jobs."""
+        recipient.end_aggregation(aggregation_id)
+
+    def finish_round(self, recipient, aggregation_id, n_submitted: int):
+        """Recipient: reveal (after clerking) and return the mean pytree.
+
+        Call after ``close_round`` and after enough clerks drained their
+        queues; raises if no snapshot is ``result_ready`` yet."""
+        output = recipient.reveal_aggregation(aggregation_id)
+        field_sum = np.asarray(output.positive().values, dtype=np.int64)
+        return dequantize_mean(
+            field_sum, n_submitted, self.spec, self.treedef, self.shapes
+        )
