@@ -37,6 +37,10 @@ import numpy as np
 from ..ops.modular import positive
 
 
+def _leaf_size(shape) -> int:
+    return int(np.prod(shape, dtype=np.int64)) if shape else 1
+
+
 def flatten_pytree(tree):
     """pytree of arrays -> ((dim,) float64 vector, treedef, shapes)."""
     import jax
@@ -52,6 +56,15 @@ def flatten_pytree(tree):
     return flat, treedef, shapes
 
 
+def tree_layout(tree):
+    """(treedef, shapes, total size) without materializing a flat copy."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [np.shape(leaf) for leaf in leaves]
+    return treedef, shapes, sum(_leaf_size(s) for s in shapes)
+
+
 def unflatten_pytree(flat, treedef, shapes):
     """Inverse of ``flatten_pytree`` (float64 leaves)."""
     import jax
@@ -59,7 +72,7 @@ def unflatten_pytree(flat, treedef, shapes):
     leaves = []
     offset = 0
     for shape in shapes:
-        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        size = _leaf_size(shape)
         leaves.append(np.asarray(flat[offset : offset + size]).reshape(shape))
         offset += size
     return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -134,8 +147,13 @@ class QuantizationSpec:
 
     def quantize(self, flat: np.ndarray) -> np.ndarray:
         """float vector -> field elements in [0, p): round-to-nearest
-        fixed point, negatives as high residues."""
-        clipped = np.clip(np.asarray(flat, dtype=np.float64), -self.clip, self.clip)
+        fixed point, negatives as high residues. Non-finite values are
+        rejected (NaN/inf would encode as garbage residues and silently
+        corrupt every aggregate sharing the coordinate)."""
+        flat = np.asarray(flat, dtype=np.float64)
+        if not np.isfinite(flat).all():
+            raise ValueError("update contains non-finite values (NaN/inf)")
+        clipped = np.clip(flat, -self.clip, self.clip)
         q = np.rint(clipped * self.scale).astype(np.int64)
         return positive(q, self.modulus)
 
@@ -173,11 +191,12 @@ class FederatedAveraging:
     """
 
     def __init__(self, spec: QuantizationSpec, template_tree):
-        flat, treedef, shapes = flatten_pytree(template_tree)
+        # layout only — no flat copy of a possibly-large template model
+        treedef, shapes, dim = tree_layout(template_tree)
         self.spec = spec
         self.treedef = treedef
         self.shapes = shapes
-        self.dim = int(flat.size)
+        self.dim = dim
 
     def open_round(
         self,
@@ -241,9 +260,10 @@ class FederatedAveraging:
             raise ValueError(
                 f"update leaf shapes {shapes} differ from template {self.shapes}"
             )
-        participant.participate(
-            self.spec.quantize(field_vec).tolist(), aggregation_id
-        )
+        # pass the int64 ndarray straight through — participate() takes
+        # array-likes; a .tolist() round-trip would allocate one Python
+        # int per model parameter
+        participant.participate(self.spec.quantize(field_vec), aggregation_id)
 
     def close_round(self, recipient, aggregation_id):
         """Recipient: freeze participations + enqueue clerking jobs."""
@@ -253,7 +273,19 @@ class FederatedAveraging:
         """Recipient: reveal (after clerking) and return the mean pytree.
 
         Call after ``close_round`` and after enough clerks drained their
-        queues; raises if no snapshot is ``result_ready`` yet."""
+        queues; raises if no snapshot is ``result_ready`` yet, or if more
+        updates were summed than the field was sized for (the revealed
+        sum would have wrapped — unrecoverable, so fail loudly)."""
+        status = recipient.service.get_aggregation_status(
+            recipient.agent, aggregation_id
+        )
+        actual = status.number_of_participations if status is not None else n_submitted
+        if max(n_submitted, actual) > self.spec.n_participants:
+            raise ValueError(
+                f"{max(n_submitted, actual)} updates summed but the field only "
+                f"holds {self.spec.n_participants} without wraparound; re-run "
+                f"the round with a spec fitted for the larger cohort"
+            )
         output = recipient.reveal_aggregation(aggregation_id)
         field_sum = np.asarray(output.positive().values, dtype=np.int64)
         return dequantize_mean(

@@ -339,24 +339,33 @@ class TpuAggregator:
         return jax.jit(mapped)
 
 
+def verified_step(agg, sums_fn):
+    """Jitted round with verification handle: ``fn(secrets, key) ->
+    (aggregate, plaintext-sum)`` — reconstruct from ``sums_fn``'s clerk
+    sums plus an independent plaintext reduction of the same secrets.
+    Shared by the single-mesh and multi-host (multihost.py) fabrics."""
+    import jax
+    from jax import lax
+
+    jnp = _jnp()
+    scheme, dim = agg.scheme, agg.dim
+
+    def step(secrets, key):
+        sums = sums_fn(secrets, key)
+        out = reconstruct(sums, range(agg.plan.share_count), scheme, dim)
+        plain = lax.rem(
+            jnp.sum(secrets.astype(jnp.int64), axis=0), jnp.int64(agg.plan.modulus)
+        )
+        return out, plain
+
+    return jax.jit(step)
+
+
 def full_training_step(scheme, dim, mesh):
     """One full secure-aggregation round as a single jitted computation:
     share + transpose + clerk-combine (sharded) then reconstruct + verify.
 
     This is the "training step" analog the driver dry-runs multi-chip.
     """
-    import jax
-    from jax import lax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    jnp = _jnp()
     agg = TpuAggregator(scheme, dim, mesh=mesh)
-    sums_fn = agg.sharded_clerk_sums()
-
-    def step(secrets, key):
-        sums = sums_fn(secrets, key)
-        out = reconstruct(sums, range(agg.plan.share_count), scheme, dim)
-        plain = lax.rem(jnp.sum(secrets.astype(jnp.int64), axis=0), jnp.int64(agg.plan.modulus))
-        return out, plain
-
-    return agg, jax.jit(step)
+    return agg, verified_step(agg, agg.sharded_clerk_sums())

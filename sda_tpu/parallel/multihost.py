@@ -1,0 +1,140 @@
+"""Multi-host distribution: hybrid ICI x DCN meshes and hierarchical sums.
+
+The reference's "distributed backend" is HTTP pull-queues between
+independent phone processes (SURVEY.md §5 — no NCCL/MPI anywhere); it
+scales hosts by adding more clerks. The TPU fabric's equivalent for
+multi-host *pods* is jax.distributed + a hybrid mesh: a fast ICI axis
+inside each slice and a slow DCN axis across hosts, with the reduction
+staged so that only the tiny per-clerk partial sums ever cross DCN.
+
+Topology mapping:
+
+- axis ``h`` (hosts / slices, DCN): coarse participant sharding — each
+  host ingests its own participant population, like each region of
+  phones talking to its nearest collector.
+- axis ``p`` (chips within a slice, ICI): fine participant sharding.
+- The per-device work is the usual share+combine; the cross-device sum
+  runs ``psum`` over ``p`` first (ICI — cheap, wide), then over ``h``
+  (DCN — only ``(n, B)`` int64 partials, KBs, regardless of how many
+  participants each host holds). Like the sum-first engine
+  (parallel/sumfirst.py), linearity is what keeps the big tensors local.
+
+Everything here is expressed in mesh axes, not transport: on one
+process with 8 CPU devices the same code runs with ``h`` and ``p`` both
+mapped to local devices (how tests and the driver dry-run validate it);
+on a real multi-host pod the identical program runs under
+``jax.distributed`` with ``h`` spanning slices.
+"""
+
+from __future__ import annotations
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """Join the multi-process JAX runtime (call once per host, before any
+    jax op). Thin, explicit wrapper over ``jax.distributed.initialize`` —
+    on TPU pods all three arguments are auto-detected from the metadata
+    server and may be omitted."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(h_size: int | None = None, p_size: int | None = None):
+    """Mesh with axes ``("h", "p")``: hosts (DCN) x chips-per-host (ICI).
+
+    Under ``jax.distributed`` with multiple processes, uses
+    ``mesh_utils.create_hybrid_device_mesh`` so ``h`` is laid out across
+    slices and ``p`` within them (collectives over ``p`` ride ICI).
+    Single-process (tests, dry runs): plain reshape of local devices —
+    same program, simulated topology.
+    """
+    import jax
+    import numpy as np
+
+    devices = jax.devices()
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        h_size = h_size or n_proc
+        p_size = p_size or (len(devices) // h_size)
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, p_size),
+            dcn_mesh_shape=(h_size, 1),
+            devices=devices,
+        )
+        return Mesh(grid, ("h", "p"))
+    from jax.sharding import Mesh
+
+    if h_size is None:
+        h_size = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
+    p_size = p_size or (len(devices) // h_size)
+    need = h_size * p_size
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(h_size, p_size)
+    return Mesh(grid, ("h", "p"))
+
+
+def shard_participants_hybrid(array, mesh):
+    """(P, dim) participants sharded over both host and chip axes."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(array, NamedSharding(mesh, P(("h", "p"), None)))
+
+
+def hierarchical_clerk_sums(scheme, dim: int, mesh):
+    """Jitted share+combine over a hybrid mesh with a staged reduction.
+
+    Returns ``fn(secrets_sharded, key) -> (n, B)`` clerk sums (replicated).
+    Stage 1 shares + locally combines each device's participant slice;
+    stage 2 psums over ``p`` (ICI); stage 3 psums the already-reduced
+    ``(n, B)`` partials over ``h`` (DCN) — the only cross-host traffic.
+    Bit-identical to the single-mesh engine for the same key-folding
+    layout (tested on a virtual hybrid mesh).
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from .engine import TpuAggregator, clerk_combine, share_participants
+
+    agg = TpuAggregator(scheme, dim, mesh=mesh)
+    plan = agg.plan
+    import jax.numpy as jnp
+
+    def local_step(secrets, key):
+        # distinct randomness per device: fold in both mesh coordinates
+        key = jax.random.fold_in(key, lax.axis_index("h"))
+        key = jax.random.fold_in(key, lax.axis_index("p"))
+        shares = share_participants(secrets, key, plan, False)
+        partial = lax.rem(clerk_combine(shares), jnp.int64(plan.modulus))
+        partial = lax.rem(lax.psum(partial, axis_name="p"), jnp.int64(plan.modulus))
+        # DCN stage: (n, B) int64 per host — KBs, independent of P
+        total = lax.psum(partial, axis_name="h")
+        return lax.rem(total, jnp.int64(plan.modulus))
+
+    mapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(("h", "p"), None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return agg, jax.jit(mapped)
+
+
+def hierarchical_secure_sum(scheme, dim: int, mesh):
+    """Full multi-host round: sharded share/combine + reconstruct + an
+    independent plaintext-sum verification path (same contract as
+    ``engine.full_training_step``, over the hybrid mesh)."""
+    from .engine import verified_step
+
+    agg, sums_fn = hierarchical_clerk_sums(scheme, dim, mesh)
+    return agg, verified_step(agg, sums_fn)

@@ -138,3 +138,38 @@ def test_submit_rejects_shape_mismatch(tmp_path):
     bad = {"w": np.zeros((2, 3)), "b": np.zeros(2), "scalar": np.zeros(())}
     with pytest.raises(ValueError, match="leaf shapes"):
         fed.submit_update(object(), object(), bad)
+
+
+def test_quantize_rejects_nonfinite():
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=4)
+    with pytest.raises(ValueError, match="non-finite"):
+        spec.quantize(np.array([0.5, np.nan]))
+    with pytest.raises(ValueError, match="non-finite"):
+        spec.quantize(np.array([np.inf]))
+
+
+def test_finish_round_rejects_oversubscription(tmp_path):
+    """Summing more updates than the field was sized for would wrap
+    silently; finish_round must fail loudly (checks both the caller's
+    count and the server-side participation count)."""
+    spec, sharing = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    fed = FederatedAveraging(spec, template())
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(8)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg_id = fed.open_round(recipient, rkey, sharing)
+        for i in range(3):  # one more than the spec's capacity
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            fed.submit_update(part, agg_id, template())
+        fed.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        with pytest.raises(ValueError, match="wraparound"):
+            fed.finish_round(recipient, agg_id, 3)
