@@ -120,8 +120,9 @@ def main() -> int:
         )
 
     if args.engine == "sumfirst":
-        from sda_tpu.ops.rng import uniform_bits_device
+        from sda_tpu.ops.rng import uniform_bits_device, uniform_bits_device_narrow
         from sda_tpu.parallel.sumfirst import (
+            MAX_NARROW_CHUNK,
             clerk_sums_from_limb_acc,
             exact_value_sums,
             limb_count_sum,
@@ -134,17 +135,29 @@ def main() -> int:
         # with zero modulo bias and no emulated 64-bit division (the 64-bit
         # `%` otherwise dominates the whole pipeline ~10x; see ops/rng.py)
         nbits = p.bit_length() - 1
+        # narrow lanes when the field fits int32: same masked-uint32 bits
+        # (identical values for the same key), but the big tensors and the
+        # whole reduction stay in native int32 ops (sumfirst narrow path)
+        narrow = nbits <= 31 and chunk <= MAX_NARROW_CHUNK
+
+        def draw_bits(key, shape, bits):
+            if narrow:
+                return uniform_bits_device_narrow(key, shape, bits)
+            return uniform_bits_device(key, shape, bits)
 
         def mask_draw(key, shape, m):
-            return uniform_bits_device(key, shape, m.bit_length() - 1)
+            return draw_bits(key, shape, m.bit_length() - 1)
 
         def body(carry, i):
             acc, plain, key = carry
             key, sk, rk = jax.random.split(key, 3)
-            secrets = uniform_bits_device(sk, (chunk, dim), nbits)
+            secrets = draw_bits(sk, (chunk, dim), nbits)
             acc = acc + value_limb_sums_chunk(secrets, rk, plan, draw=mask_draw)
-            # independent check path: int64 wraparound sums (exact mod 2^64)
-            return (acc, plain + jnp.sum(secrets, axis=0), key), ()
+            # check path: plain int64 sums (wraparound-exact mod 2^64) —
+            # deliberately NOT exact_sum_narrow, so the verification stays
+            # independent of the limb reduction it is checking
+            csum = jnp.sum(secrets.astype(jnp.int64), axis=0)
+            return (acc, plain + csum, key), ()
 
         def finalize(acc, plain):
             # cross-check the limb reduction against the independent

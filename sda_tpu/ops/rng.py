@@ -71,3 +71,18 @@ def uniform_bits_device(key, shape, nbits: int):
     dtype = jnp.uint32 if nbits <= 32 else jnp.uint64
     u = random.bits(key, shape=shape, dtype=dtype)
     return (u & dtype((1 << nbits) - 1)).astype(jnp.int64)
+
+
+def uniform_bits_device_narrow(key, shape, nbits: int):
+    """``uniform_bits_device`` for ``nbits <= 31``, kept int32.
+
+    Same bits as the wide variant for the same key (uint32 draw, masked),
+    but never widened — feeds the narrow (int32) hot paths where emulated
+    64-bit lanes would halve throughput (parallel/sumfirst.py)."""
+    import jax.numpy as jnp
+    from jax import random
+
+    if not (0 < nbits <= 31):
+        raise ValueError(f"narrow draw needs nbits <= 31, got {nbits}")
+    u = random.bits(key, shape=shape, dtype=jnp.uint32)
+    return (u & jnp.uint32((1 << nbits) - 1)).astype(jnp.int32)

@@ -117,3 +117,41 @@ def test_rejects_oversized_chunk(jax_mods):
 
     with pytest.raises(ValueError):
         clerk_sums_sum_first(FakeShaped(), None, plan)
+
+
+def test_exact_sum_narrow_matches_int64(jax_mods):
+    """The int32 narrow reduction must equal plain int64 sums exactly,
+    including at the value bound (2^31 - 1) and the row bound (2^15)."""
+    import jax.numpy as jnp
+
+    from sda_tpu.parallel.sumfirst import MAX_NARROW_CHUNK, exact_sum_narrow
+
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, (1 << 31) - 1, size=(257, 33), dtype=np.int64)
+    x[0, :] = (1 << 31) - 1  # boundary values
+    got = np.asarray(exact_sum_narrow(jnp.asarray(x)))
+    np.testing.assert_array_equal(got, x.sum(axis=0))
+
+    # worst case: max rows, all at the max value — the int32 limb bound
+    worst = np.full((MAX_NARROW_CHUNK, 3), (1 << 31) - 1, dtype=np.int64)
+    got = np.asarray(exact_sum_narrow(jnp.asarray(worst)))
+    np.testing.assert_array_equal(got, worst.sum(axis=0))
+
+    with pytest.raises(ValueError, match="narrow reduction bound"):
+        exact_sum_narrow(jnp.zeros((MAX_NARROW_CHUNK + 1, 2), dtype=jnp.int32))
+
+
+def test_narrow_draws_match_wide(jax_mods):
+    """uniform_bits_device_narrow must produce the same values as the wide
+    variant for the same key (same masked uint32 stream, different dtype) —
+    the bench switches between them by modulus width."""
+    import jax
+    import jax.numpy as jnp
+
+    from sda_tpu.ops.rng import uniform_bits_device, uniform_bits_device_narrow
+
+    key = jax.random.key(9)
+    wide = uniform_bits_device(key, (64, 5), 30)
+    narrow = uniform_bits_device_narrow(key, (64, 5), 30)
+    assert narrow.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(narrow), np.asarray(wide))
