@@ -17,9 +17,11 @@ from .federated import (
     quantize_update,
     unflatten_pytree,
 )
+from .trainer import FederatedTrainer
 
 __all__ = [
     "FederatedAveraging",
+    "FederatedTrainer",
     "QuantizationSpec",
     "dequantize_mean",
     "flatten_pytree",

@@ -1,0 +1,166 @@
+"""Multi-round federated training driver with durable checkpoints.
+
+One ``FederatedAveraging`` round aggregates a single cohort of updates;
+real federated learning iterates: broadcast the global model, collect a
+secure mean update, apply it, repeat. This driver owns that loop and its
+durability. Matching the reference's checkpoint philosophy — everything
+durable-by-construction, resume by re-reading state (SURVEY.md §5) — the
+trainer persists the global model + round counter after every apply, so
+a crashed coordinator resumes from its last completed round. The rerun
+opens a *fresh* aggregation (ids are minted per round), which is what
+makes it safe: the crashed round's aggregation is simply abandoned
+server-side — ``delete_aggregation`` can garbage-collect it — and a
+double-apply is impossible because save happens only after apply.
+
+Checkpoints are plain ``.npz`` files of the flattened model plus layout
+metadata — no format dependencies, loadable anywhere numpy exists. The
+flatten layout is the same one the wire path uses (federated.py), so a
+checkpoint is also a spec-compatible record of what was broadcast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from .federated import FederatedAveraging, flatten_pytree, unflatten_pytree
+
+
+class FederatedTrainer:
+    """Iterated secure FedAvg over any ``SdaService``.
+
+    ``apply_update`` defaults to plain FedAvg (add the mean update to the
+    global model); pass a custom function for server-side learning rates
+    or momentum. ``checkpoint_dir=None`` disables persistence.
+    """
+
+    def __init__(
+        self,
+        fed: FederatedAveraging,
+        global_model,
+        *,
+        checkpoint_dir: str | None = None,
+        apply_update=None,
+        keep_checkpoints: int = 3,
+    ):
+        self.fed = fed
+        self.global_model = global_model
+        self.round_index = 0
+        self.checkpoint_dir = checkpoint_dir
+        self.apply_update = apply_update or self._fedavg_apply
+        self.keep_checkpoints = max(1, keep_checkpoints)
+
+    @staticmethod
+    def _fedavg_apply(global_model, mean_update):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda g, u: np.asarray(g, dtype=np.float64) + np.asarray(u),
+            global_model,
+            mean_update,
+        )
+
+    # -- persistence ---------------------------------------------------------
+
+    def _ckpt_path(self) -> str:
+        return os.path.join(self.checkpoint_dir, f"round_{self.round_index:06d}.npz")
+
+    @staticmethod
+    def _ckpt_round(filename: str) -> int:
+        return int(filename[len("round_") : -len(".npz")])
+
+    def save(self) -> str:
+        """Write the current global model + round counter; atomic rename
+        (same write-then-rename discipline as the file store). Keeps the
+        last ``keep_checkpoints`` files and prunes older ones."""
+        if self.checkpoint_dir is None:
+            raise ValueError("trainer has no checkpoint_dir")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        flat, _, shapes = flatten_pytree(self.global_model)
+        path = self._ckpt_path()
+        fd, tmp = tempfile.mkstemp(dir=self.checkpoint_dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                np.savez(
+                    fh,
+                    flat=flat,
+                    round_index=self.round_index,
+                    shapes=json.dumps([list(s) for s in shapes]),
+                    treedef=str(self.fed.treedef),
+                )
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        for old in self._checkpoints()[: -self.keep_checkpoints]:
+            os.unlink(os.path.join(self.checkpoint_dir, old))
+        return path
+
+    def _checkpoints(self) -> list:
+        """Checkpoint filenames, oldest first (numeric round order — a
+        lexicographic sort would misorder once rounds outgrow the name's
+        zero padding)."""
+        return sorted(
+            (
+                f
+                for f in os.listdir(self.checkpoint_dir)
+                if f.startswith("round_") and f.endswith(".npz")
+            ),
+            key=self._ckpt_round,
+        )
+
+    def restore_latest(self) -> bool:
+        """Load the newest checkpoint, if any. Returns whether one loaded."""
+        if self.checkpoint_dir is None or not os.path.isdir(self.checkpoint_dir):
+            return False
+        ckpts = self._checkpoints()
+        if not ckpts:
+            return False
+        with np.load(os.path.join(self.checkpoint_dir, ckpts[-1])) as data:
+            shapes = [tuple(s) for s in json.loads(str(data["shapes"]))]
+            # both structure and shapes must match — equal shape lists with
+            # different treedefs would silently cross-map parameters
+            if "treedef" in data and str(data["treedef"]) != str(self.fed.treedef):
+                raise ValueError(
+                    "checkpoint layout differs from the template model (treedef)"
+                )
+            if shapes != [tuple(s) for s in self.fed.shapes]:
+                raise ValueError("checkpoint layout differs from the template model")
+            self.global_model = unflatten_pytree(
+                data["flat"], self.fed.treedef, self.fed.shapes
+            )
+            self.round_index = int(data["round_index"])
+        return True
+
+    # -- the round loop ------------------------------------------------------
+
+    def run_round(self, recipient, recipient_key, sharing_scheme, submitters, workers):
+        """One full secure round: open, collect, clerk, reveal, apply, save.
+
+        ``submitters``: list of ``(client, update_fn)`` — ``update_fn``
+        receives the current global model and returns an update pytree
+        (e.g. local SGD delta); each client runs full participation.
+        ``workers``: clients that drain clerking queues (committee
+        members among them do the clerking).
+        """
+        agg_id = self.fed.open_round(
+            recipient,
+            recipient_key,
+            sharing_scheme,
+            title=f"federated-round-{self.round_index}",
+        )
+        for client, update_fn in submitters:
+            self.fed.submit_update(client, agg_id, update_fn(self.global_model))
+        self.fed.close_round(recipient, agg_id)
+        for worker in workers:
+            worker.run_chores(-1)
+        mean_update = self.fed.finish_round(recipient, agg_id, len(submitters))
+        self.global_model = self.apply_update(self.global_model, mean_update)
+        self.round_index += 1
+        if self.checkpoint_dir is not None:
+            self.save()
+        return self.global_model

@@ -1,0 +1,147 @@
+"""Multi-round federated training (models/trainer.py): a logistic-regression
+model trained over secure-aggregation rounds must actually learn, and a
+crashed coordinator must resume from its checkpoint bit-exactly."""
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, with_service
+from sda_tpu.models import FederatedAveraging, QuantizationSpec
+from sda_tpu.models.trainer import FederatedTrainer
+
+
+def _data(seed, n=80):
+    """Linearly separable 2-class data, split per participant."""
+    rng = np.random.default_rng(seed)
+    w_true = np.array([1.5, -2.0])
+    x = rng.normal(size=(n, 2))
+    y = (x @ w_true + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+    return x, y
+
+
+def _loss(model, x, y):
+    z = x @ model["w"] + model["b"]
+    pz = 1 / (1 + np.exp(-z))
+    eps = 1e-9
+    return float(-np.mean(y * np.log(pz + eps) + (1 - y) * np.log(1 - pz + eps)))
+
+
+def _local_update(x, y, lr=0.5, steps=5):
+    """update_fn factory: a few local gradient steps, return the delta."""
+
+    def fn(global_model):
+        w, b = global_model["w"].copy(), float(global_model["b"])
+        for _ in range(steps):
+            z = x @ w + b
+            pz = 1 / (1 + np.exp(-z))
+            grad_w = x.T @ (pz - y) / len(y)
+            grad_b = float(np.mean(pz - y))
+            w -= lr * grad_w
+            b -= lr * grad_b
+        return {"w": w - global_model["w"], "b": np.array(b - float(global_model["b"]))}
+
+    return fn
+
+
+def _setup(ctx, tmp_path):
+    recipient = new_client(tmp_path / "r", ctx.service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(8)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    return recipient, rkey, clerks
+
+
+def test_training_learns_and_checkpoints(tmp_path):
+    template = {"w": np.zeros(2), "b": np.zeros(())}
+    spec, sharing = QuantizationSpec.fitted(frac_bits=20, clip=8.0, n_participants=8)
+    fed = FederatedAveraging(spec, template)
+
+    datasets = [_data(seed) for seed in range(4)]
+    all_x = np.concatenate([d[0] for d in datasets])
+    all_y = np.concatenate([d[1] for d in datasets])
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        participants = []
+        for i, (x, y) in enumerate(datasets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            participants.append((part, _local_update(x, y)))
+
+        trainer = FederatedTrainer(
+            fed, template, checkpoint_dir=str(tmp_path / "ckpt")
+        )
+        losses = [_loss(trainer.global_model, all_x, all_y)]
+        for _ in range(3):
+            trainer.run_round(recipient, rkey, sharing, participants, [recipient] + clerks)
+            losses.append(_loss(trainer.global_model, all_x, all_y))
+
+    assert losses[-1] < losses[0] * 0.5, f"did not learn: {losses}"
+    assert trainer.round_index == 3
+
+    # resume: a fresh trainer restores the exact post-round-3 state
+    resumed = FederatedTrainer(fed, template, checkpoint_dir=str(tmp_path / "ckpt"))
+    assert resumed.restore_latest()
+    assert resumed.round_index == 3
+    np.testing.assert_array_equal(resumed.global_model["w"], trainer.global_model["w"])
+    np.testing.assert_array_equal(resumed.global_model["b"], trainer.global_model["b"])
+
+
+def test_restore_rejects_layout_mismatch(tmp_path):
+    template = {"w": np.zeros(2), "b": np.zeros(())}
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    trainer = FederatedTrainer(
+        FederatedAveraging(spec, template), template, checkpoint_dir=str(tmp_path)
+    )
+    trainer.save()
+    other = {"w": np.zeros(3), "b": np.zeros(())}
+    bad = FederatedTrainer(
+        FederatedAveraging(spec, other), other, checkpoint_dir=str(tmp_path)
+    )
+    with pytest.raises(ValueError, match="layout"):
+        bad.restore_latest()
+
+
+def test_restore_without_checkpoints():
+    template = {"w": np.zeros(2)}
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    trainer = FederatedTrainer(FederatedAveraging(spec, template), template)
+    assert not trainer.restore_latest()
+
+
+def test_checkpoint_pruning_and_numeric_order(tmp_path):
+    template = {"w": np.zeros(2)}
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    fed = FederatedAveraging(spec, template)
+    trainer = FederatedTrainer(
+        fed, template, checkpoint_dir=str(tmp_path), keep_checkpoints=2
+    )
+    for i in range(5):
+        trainer.global_model = {"w": np.full(2, float(i))}
+        trainer.save()
+        trainer.round_index += 1
+    kept = trainer._checkpoints()
+    assert kept == ["round_000003.npz", "round_000004.npz"]
+    resumed = FederatedTrainer(fed, template, checkpoint_dir=str(tmp_path))
+    assert resumed.restore_latest()
+    assert resumed.round_index == 4
+    np.testing.assert_array_equal(resumed.global_model["w"], np.full(2, 4.0))
+
+
+def test_restore_rejects_treedef_mismatch(tmp_path):
+    """Equal shape lists under different structures must not cross-map."""
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    a = {"a": np.zeros(3), "b": np.zeros(3)}
+    FederatedTrainer(
+        FederatedAveraging(spec, a), a, checkpoint_dir=str(tmp_path)
+    ).save()
+    x = {"x": np.zeros(3), "y": np.zeros(3)}
+    bad = FederatedTrainer(
+        FederatedAveraging(spec, x), x, checkpoint_dir=str(tmp_path)
+    )
+    with pytest.raises(ValueError, match="treedef"):
+        bad.restore_latest()
