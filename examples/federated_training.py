@@ -1,0 +1,99 @@
+"""Runnable end-to-end demo: private federated training of a logistic
+regression, in one process.
+
+Four hospitals (participants) hold disjoint patient data; they train a
+shared model without any party — server, clerks, recipient — ever seeing
+an individual hospital's update. Everything below is the real protocol:
+committee election, ChaCha masking, packed-Shamir sharing, sealed-box
+transport, snapshot/clerking, Lagrange reconstruction.
+
+Run:  python examples/federated_training.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from sda_tpu.client import SdaClient
+from sda_tpu.crypto.keystore import Keystore
+from sda_tpu.models import FederatedAveraging, FederatedTrainer, QuantizationSpec
+from sda_tpu.server import new_mem_server
+
+
+def make_client(service, path):
+    ks = Keystore(path)
+    client = SdaClient(SdaClient.new_agent(ks), ks, service)
+    client.upload_agent()
+    return client
+
+
+def local_sgd(x, y, lr=0.5, steps=5):
+    """Participant-side training: local steps, return the weight delta."""
+
+    def fn(global_model):
+        w, b = global_model["w"].copy(), float(global_model["b"])
+        for _ in range(steps):
+            p = 1 / (1 + np.exp(-(x @ w + b)))
+            w -= lr * (x.T @ (p - y)) / len(y)
+            b -= lr * float(np.mean(p - y))
+        return {"w": w - global_model["w"], "b": np.array(b - float(global_model["b"]))}
+
+    return fn
+
+
+def main():
+    service = new_mem_server()
+    tmp = tempfile.mkdtemp()
+
+    recipient = make_client(service, f"{tmp}/recipient")
+    recipient_key = recipient.new_encryption_key()
+    recipient.upload_encryption_key(recipient_key)
+    clerks = [make_client(service, f"{tmp}/clerk{i}") for i in range(8)]
+    for clerk in clerks:
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+
+    # synthetic "hospitals": disjoint shards of one linearly separable task
+    rng = np.random.default_rng(0)
+    w_true = np.array([1.5, -2.0])
+    hospitals = []
+    for i in range(4):
+        x = rng.normal(size=(100, 2))
+        y = (x @ w_true + 0.1 * rng.normal(size=100) > 0).astype(np.float64)
+        part = make_client(service, f"{tmp}/hospital{i}")
+        hospitals.append(((part, local_sgd(x, y)), (x, y)))
+    submitters = [h[0] for h in hospitals]
+    all_x = np.concatenate([h[1][0] for h in hospitals])
+    all_y = np.concatenate([h[1][1] for h in hospitals])
+
+    template = {"w": np.zeros(2), "b": np.zeros(())}
+    spec, sharing = QuantizationSpec.fitted(frac_bits=20, clip=8.0, n_participants=8)
+    trainer = FederatedTrainer(
+        FederatedAveraging(spec, template),
+        template,
+        checkpoint_dir=f"{tmp}/checkpoints",
+    )
+
+    def loss(model):
+        p = 1 / (1 + np.exp(-(all_x @ model["w"] + float(model["b"]))))
+        eps = 1e-9
+        return float(-np.mean(all_y * np.log(p + eps) + (1 - all_y) * np.log(1 - p + eps)))
+
+    print(f"round 0: loss={loss(trainer.global_model):.4f} (untrained)")
+    for _ in range(4):
+        trainer.run_round(
+            recipient, recipient_key, sharing, submitters, [recipient] + clerks
+        )
+        print(
+            f"round {trainer.round_index}: loss={loss(trainer.global_model):.4f} "
+            f"w={np.round(trainer.global_model['w'], 3)}"
+        )
+    print(f"checkpoints in {tmp}/checkpoints")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,7 +79,14 @@ class FederatedTrainer:
         if self.checkpoint_dir is None:
             raise ValueError("trainer has no checkpoint_dir")
         os.makedirs(self.checkpoint_dir, exist_ok=True)
-        flat, _, shapes = flatten_pytree(self.global_model)
+        flat, treedef, shapes = flatten_pytree(self.global_model)
+        if treedef != self.fed.treedef:
+            # a custom apply_update drifted the model's structure — fail at
+            # save time, not as silent cross-mapping at restore time
+            raise ValueError(
+                f"global model structure {treedef} differs from the "
+                f"aggregation template {self.fed.treedef}"
+            )
         path = self._ckpt_path()
         fd, tmp = tempfile.mkstemp(dir=self.checkpoint_dir, suffix=".tmp")
         try:
@@ -103,15 +110,16 @@ class FederatedTrainer:
     def _checkpoints(self) -> list:
         """Checkpoint filenames, oldest first (numeric round order — a
         lexicographic sort would misorder once rounds outgrow the name's
-        zero padding)."""
-        return sorted(
-            (
-                f
-                for f in os.listdir(self.checkpoint_dir)
-                if f.startswith("round_") and f.endswith(".npz")
-            ),
-            key=self._ckpt_round,
-        )
+        zero padding). Foreign files (e.g. an operator's round_best.npz
+        copy) are ignored, never touched by pruning."""
+        found = []
+        for f in os.listdir(self.checkpoint_dir):
+            if f.startswith("round_") and f.endswith(".npz"):
+                try:
+                    found.append((self._ckpt_round(f), f))
+                except ValueError:
+                    continue
+        return [f for _, f in sorted(found)]
 
     def restore_latest(self) -> bool:
         """Load the newest checkpoint, if any. Returns whether one loaded."""

@@ -145,3 +145,27 @@ def test_restore_rejects_treedef_mismatch(tmp_path):
     )
     with pytest.raises(ValueError, match="treedef"):
         bad.restore_latest()
+
+
+def test_checkpoints_ignore_foreign_files(tmp_path):
+    template = {"w": np.zeros(2)}
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    fed = FederatedAveraging(spec, template)
+    trainer = FederatedTrainer(fed, template, checkpoint_dir=str(tmp_path))
+    trainer.save()
+    (tmp_path / "round_best.npz").write_bytes(b"not a checkpoint")
+    assert trainer._checkpoints() == ["round_000000.npz"]
+    trainer.round_index = 1
+    trainer.save()  # pruning must not crash on (or delete) the foreign file
+    assert (tmp_path / "round_best.npz").exists()
+
+
+def test_save_rejects_structural_drift(tmp_path):
+    template = {"a": np.zeros(2), "b": np.zeros(2)}
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    trainer = FederatedTrainer(
+        FederatedAveraging(spec, template), template, checkpoint_dir=str(tmp_path)
+    )
+    trainer.global_model = {"x": np.zeros(2), "y": np.zeros(2)}  # drifted keys
+    with pytest.raises(ValueError, match="structure"):
+        trainer.save()
