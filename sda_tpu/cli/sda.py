@@ -31,6 +31,7 @@ from ..protocol import (
     FullMasking,
     NoMasking,
     AdditiveSharing,
+    BasicShamirSharing,
     PackedShamirSharing,
     SodiumEncryptionScheme,
 )
@@ -78,7 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
     create.add_argument("share_count", type=int)
     create.add_argument("--id")
     create.add_argument("--mask", choices=["none", "full", "chacha"], default="none")
-    create.add_argument("--sharing", choices=["add", "shamir"], default="add")
+    create.add_argument(
+        "--sharing", choices=["add", "shamir", "basic"], default="add",
+        help="add = n-of-n additive; shamir = packed Shamir (generated field); "
+        "basic = classic Shamir (any prime modulus, any committee size)",
+    )
     create.add_argument("--secret-count", type=int, help="shamir: secrets packed per batch")
     create.add_argument("--privacy-threshold", type=int, help="shamir: collusion tolerance")
     for name in ("begin", "end", "reveal"):
@@ -111,6 +116,17 @@ def cmd_aggregations_create(client, args) -> None:
     modulus = args.modulus
     if args.sharing == "add":
         sharing = AdditiveSharing(share_count=args.share_count, modulus=modulus)
+    elif args.sharing == "basic":
+        from ..ops.params import is_prime
+
+        if not is_prime(modulus):
+            raise SystemExit(f"basic Shamir needs a prime modulus, got {modulus}")
+        t = (args.share_count - 1) if args.privacy_threshold is None else args.privacy_threshold
+        if not 0 < t < args.share_count:
+            raise SystemExit(f"privacy threshold {t} must be in (0, share_count)")
+        sharing = BasicShamirSharing(
+            share_count=args.share_count, privacy_threshold=t, prime_modulus=modulus
+        )
     else:
         from ..ops import find_packed_parameters
 

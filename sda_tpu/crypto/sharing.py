@@ -19,7 +19,7 @@ import numpy as np
 from ..ops import shamir
 from ..ops.modular import MAX_SAFE_MODULUS, mod_sum_wide_np, modmatmul_np, rust_rem_np
 from ..ops.rng import uniform_mod_host
-from ..protocol import AdditiveSharing, PackedShamirSharing
+from ..protocol import AdditiveSharing, BasicShamirSharing, PackedShamirSharing
 
 
 class ShareGenerator:
@@ -71,14 +71,16 @@ class AdditiveShareGenerator(ShareGenerator):
 
 
 class PackedShamirShareGenerator(ShareGenerator):
-    """Packed Shamir sharing as one batched mod-p matmul (ops/shamir.py)."""
+    """Shamir sharing (packed or basic) as one batched mod-p matmul
+    (ops/shamir.py) — both schemes are linear maps; only the matrix and
+    batch width (``input_size``: k for packed, 1 for basic) differ."""
 
-    def __init__(self, scheme: PackedShamirSharing):
+    def __init__(self, scheme):
         self.scheme = scheme
         self.S = shamir.share_matrix(scheme)
 
     def generate(self, secrets):
-        k = self.scheme.secret_count
+        k = self.scheme.input_size
         t = self.scheme.privacy_threshold
         p = self.scheme.prime_modulus
         batches = _batched(secrets, k)  # (B, k)
@@ -120,7 +122,7 @@ class PackedShamirReconstructor(SecretReconstructor):
     dropout-recovery path (reference receive.rs:127-145, batched.rs:68-98).
     """
 
-    def __init__(self, scheme: PackedShamirSharing, dimension: int):
+    def __init__(self, scheme, dimension: int):
         self.scheme = scheme
         self.dimension = dimension
 
@@ -138,7 +140,7 @@ class PackedShamirReconstructor(SecretReconstructor):
 def new_share_generator(scheme) -> ShareGenerator:
     if isinstance(scheme, AdditiveSharing):
         return AdditiveShareGenerator(scheme.share_count, scheme.modulus)
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, (BasicShamirSharing, PackedShamirSharing)):
         return PackedShamirShareGenerator(scheme)
     raise TypeError(f"unknown sharing scheme {scheme!r}")
 
@@ -146,7 +148,7 @@ def new_share_generator(scheme) -> ShareGenerator:
 def new_share_combiner(scheme) -> ShareCombiner:
     if isinstance(scheme, AdditiveSharing):
         return Combiner(scheme.modulus)
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, (BasicShamirSharing, PackedShamirSharing)):
         return Combiner(scheme.prime_modulus)
     raise TypeError(f"unknown sharing scheme {scheme!r}")
 
@@ -154,6 +156,6 @@ def new_share_combiner(scheme) -> ShareCombiner:
 def new_secret_reconstructor(scheme, dimension: int) -> SecretReconstructor:
     if isinstance(scheme, AdditiveSharing):
         return AdditiveReconstructor(scheme.modulus)
-    if isinstance(scheme, PackedShamirSharing):
+    if isinstance(scheme, (BasicShamirSharing, PackedShamirSharing)):
         return PackedShamirReconstructor(scheme, dimension)
     raise TypeError(f"unknown sharing scheme {scheme!r}")

@@ -44,7 +44,7 @@ import numpy as np
 
 from ..ops import shamir
 from ..ops.jaxcfg import ensure_x64
-from ..protocol import AdditiveSharing, PackedShamirSharing
+from ..protocol import AdditiveSharing, BasicShamirSharing, PackedShamirSharing
 
 
 @dataclass(frozen=True)
@@ -61,8 +61,8 @@ class AggregationPlan:
 
 
 def make_plan(scheme, dim: int) -> AggregationPlan:
-    if isinstance(scheme, PackedShamirSharing):
-        k = scheme.secret_count
+    if isinstance(scheme, (BasicShamirSharing, PackedShamirSharing)):
+        k = scheme.input_size  # secret_count for packed, 1 for basic
         return AggregationPlan(
             modulus=scheme.prime_modulus,
             dim=dim,

@@ -194,10 +194,16 @@ class LinearSecretSharingScheme:
 
     @staticmethod
     def from_json(obj):
-        tag, payload = _untag(obj, ("Additive", "PackedShamir"))
+        tag, payload = _untag(obj, ("Additive", "BasicShamir", "PackedShamir"))
         if tag == "Additive":
             return AdditiveSharing(
                 share_count=int(payload["share_count"]), modulus=int(payload["modulus"])
+            )
+        if tag == "BasicShamir":
+            return BasicShamirSharing(
+                share_count=int(payload["share_count"]),
+                privacy_threshold=int(payload["privacy_threshold"]),
+                prime_modulus=int(payload["prime_modulus"]),
             )
         return PackedShamirSharing(
             secret_count=int(payload["secret_count"]),
@@ -235,6 +241,54 @@ class AdditiveSharing(LinearSecretSharingScheme):
     def to_json(self):
         return _tagged(
             "Additive", {"share_count": self.share_count, "modulus": self.modulus}
+        )
+
+
+@dataclass(frozen=True)
+class BasicShamirSharing(LinearSecretSharingScheme):
+    """Classic (non-packed) Shamir over F_p: one degree-t polynomial per
+    secret, shares at points 1..n, reconstruction from any t+1 shares.
+
+    The reference sketches this variant but leaves it commented out
+    (crypto.rs:89-96, same field names); here it is implemented — unlike
+    packed Shamir it imposes NO radix structure on the field or committee
+    (any prime, any share_count), at the cost of one polynomial per
+    element instead of per k-batch.
+    """
+
+    share_count: int
+    privacy_threshold: int
+    prime_modulus: int
+
+    def __post_init__(self):
+        if not 0 < self.privacy_threshold < self.share_count:
+            raise ValueError("need 0 < privacy_threshold < share_count")
+        if self.share_count >= self.prime_modulus:
+            # evaluation points 1..n must be distinct and nonzero mod p: a
+            # point ≡ 0 would hand a clerk the raw secret, colliding points
+            # make reveal impossible — reject at construction (incl. wire)
+            raise ValueError("share_count must be below the prime modulus")
+
+    @property
+    def input_size(self) -> int:
+        return 1
+
+    @property
+    def output_size(self) -> int:
+        return self.share_count
+
+    @property
+    def reconstruction_threshold(self) -> int:
+        return self.privacy_threshold + 1
+
+    def to_json(self):
+        return _tagged(
+            "BasicShamir",
+            {
+                "share_count": self.share_count,
+                "privacy_threshold": self.privacy_threshold,
+                "prime_modulus": self.prime_modulus,
+            },
         )
 
 

@@ -119,3 +119,16 @@ def test_with_packedshamir(tmp_path):
         omega_shares=150,
     )
     check_full_aggregation(agg, tmp_path)
+
+
+def test_with_basic_shamir(tmp_path):
+    """Classic (non-packed) Shamir — the variant the reference sketches but
+    leaves commented out (crypto.rs:89-96). 5 clerks, threshold 2: any 3
+    results reconstruct, so the protocol tolerates 2 missing clerks."""
+    from sda_tpu.protocol import BasicShamirSharing
+
+    agg = agg_default()
+    agg.committee_sharing_scheme = BasicShamirSharing(
+        share_count=5, privacy_threshold=2, prime_modulus=433
+    )
+    check_full_aggregation(agg, tmp_path)

@@ -192,3 +192,32 @@ def test_sharded_matches_engine_across_mesh_shapes(jax_mods):
         np.testing.assert_array_equal(
             positive(np.asarray(out), p), _plain_sum(secrets, p)
         )
+
+
+def test_basic_shamir_engine_end_to_end():
+    """BasicShamir through the TPU engine: secure_sum over a 30-bit prime
+    with reconstruction from a dropped-clerk subset."""
+    import jax
+    import numpy as np
+
+    from sda_tpu.ops.modular import positive
+    from sda_tpu.ops.params import is_prime
+    from sda_tpu.parallel import TpuAggregator
+    from sda_tpu.protocol import BasicShamirSharing
+
+    p = (1 << 30) + 3
+    while not is_prime(p):
+        p += 2
+    scheme = BasicShamirSharing(share_count=6, privacy_threshold=2, prime_modulus=p)
+    dim, P = 37, 11
+    rng = np.random.default_rng(2)
+    secrets = rng.integers(0, p, size=(P, dim))
+    agg = TpuAggregator(scheme, dim)
+    import jax.numpy as jnp
+
+    out = agg.secure_sum(
+        jnp.asarray(secrets), jax.random.key(0), indices=[0, 2, 5]  # 3 of 6 survive
+    )
+    np.testing.assert_array_equal(
+        positive(np.asarray(out), p), secrets.sum(axis=0) % p
+    )

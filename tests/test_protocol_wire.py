@@ -214,3 +214,40 @@ def test_participation_and_committee_roundtrip():
     )
     roundtrip(job, ClerkingJob.from_json)
     roundtrip(Snapshot(id=SnapshotId.random(), aggregation=agg_id), Snapshot.from_json)
+
+
+def test_basic_shamir_wire_roundtrip():
+    """BasicShamir JSON tag + fields match the reference's commented enum
+    variant (crypto.rs:89-96) so the wire format stays aligned if upstream
+    ever uncomment it."""
+    from sda_tpu.protocol import BasicShamirSharing, LinearSecretSharingScheme
+
+    s = BasicShamirSharing(share_count=5, privacy_threshold=2, prime_modulus=433)
+    obj = s.to_json()
+    assert obj == {
+        "BasicShamir": {
+            "share_count": 5,
+            "privacy_threshold": 2,
+            "prime_modulus": 433,
+        }
+    }
+    assert LinearSecretSharingScheme.from_json(obj) == s
+    assert s.reconstruction_threshold == 3 and s.input_size == 1
+
+
+def test_basic_shamir_rejects_degenerate_params():
+    """share_count >= p wraps evaluation points mod p: a clerk at x = 0
+    would receive the raw secret and collisions break reveal — must be
+    rejected at construction (and therefore also at wire decode)."""
+    import pytest
+
+    from sda_tpu.protocol import BasicShamirSharing, LinearSecretSharingScheme
+
+    with pytest.raises(ValueError, match="below the prime"):
+        BasicShamirSharing(share_count=8, privacy_threshold=2, prime_modulus=7)
+    with pytest.raises(ValueError, match="privacy_threshold"):
+        BasicShamirSharing(share_count=3, privacy_threshold=3, prime_modulus=433)
+    with pytest.raises(ValueError, match="below the prime"):
+        LinearSecretSharingScheme.from_json(
+            {"BasicShamir": {"share_count": 8, "privacy_threshold": 2, "prime_modulus": 7}}
+        )
