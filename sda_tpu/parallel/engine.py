@@ -282,8 +282,7 @@ class TpuAggregator:
             )
 
         def local_step(secrets, key):
-            idx = lax.axis_index("p")
-            key = jax.random.fold_in(key, idx)
+            key = fold_mesh_axes(key, self.mesh)
             shares = share_participants(secrets, key, plan, use_limbs)  # (Pl, n, B)
             # reshard: split the clerk axis across "p", gather participants —
             # afterwards each device holds (P_total_local_group, n/p, B)
@@ -319,10 +318,9 @@ class TpuAggregator:
 
         def local_step(secrets, key):
             # per-device: share own participant slice, sum locally, psum.
-            # key is folded with the device's participant-axis index so
-            # every shard draws distinct randomness.
-            idx = lax.axis_index("p")
-            key = jax.random.fold_in(key, idx)
+            # every device folds all mesh coordinates into the key, so
+            # every shard draws distinct randomness (see fold_mesh_axes)
+            key = fold_mesh_axes(key, self.mesh)
             shares = share_participants(secrets, key, plan, use_limbs)
             partial = clerk_combine(shares)  # (n, B_local) int64
             partial = lax.rem(partial, jnp.int64(modulus))
@@ -337,6 +335,25 @@ class TpuAggregator:
             check_vma=False,
         )
         return jax.jit(mapped)
+
+
+
+def fold_mesh_axes(key, mesh):
+    """Fold every mesh-axis index into the PRNG key (inside shard_map).
+
+    Folding only one axis would hand devices that differ on another axis
+    the SAME key: with the dim axis ``d`` sharded, two d-shards of one
+    participant row would then draw identical share randomness for
+    different dim slices — subtracting a clerk's shares across shards
+    cancels it, a zero-privacy failure. Every sharded path (here and
+    multihost.py) derives per-device randomness through this one helper.
+    """
+    import jax
+    from jax import lax
+
+    for axis in mesh.axis_names:
+        key = jax.random.fold_in(key, lax.axis_index(axis))
+    return key
 
 
 def verified_step(agg, sums_fn):

@@ -43,12 +43,16 @@ def initialize_distributed(coordinator_address=None, num_processes=None, process
     )
 
 
-def make_hybrid_mesh(h_size: int | None = None, p_size: int | None = None):
-    """Mesh with axes ``("h", "p")``: hosts (DCN) x chips-per-host (ICI).
+def make_hybrid_mesh(
+    h_size: int | None = None, p_size: int | None = None, d_size: int = 1
+):
+    """Mesh with axes ``("h", "p", "d")``: hosts (DCN) x chips-per-host
+    (ICI, participant axis) x dim batches (ICI, the dimension-batching /
+    sequence-parallel axis for 100K-dim vectors).
 
     Under ``jax.distributed`` with multiple processes, uses
     ``mesh_utils.create_hybrid_device_mesh`` so ``h`` is laid out across
-    slices and ``p`` within them (collectives over ``p`` ride ICI).
+    slices and ``p``/``d`` within them (those collectives ride ICI).
     Single-process (tests, dry runs): plain reshape of local devices —
     same program, simulated topology.
     """
@@ -62,31 +66,31 @@ def make_hybrid_mesh(h_size: int | None = None, p_size: int | None = None):
         from jax.sharding import Mesh
 
         h_size = h_size or n_proc
-        p_size = p_size or (len(devices) // h_size)
+        p_size = p_size or (len(devices) // (h_size * d_size))
         grid = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(1, p_size),
-            dcn_mesh_shape=(h_size, 1),
+            mesh_shape=(1, p_size, d_size),
+            dcn_mesh_shape=(h_size, 1, 1),
             devices=devices,
         )
-        return Mesh(grid, ("h", "p"))
+        return Mesh(grid, ("h", "p", "d"))
     from jax.sharding import Mesh
 
     if h_size is None:
         h_size = 2 if len(devices) % 2 == 0 and len(devices) > 1 else 1
-    p_size = p_size or (len(devices) // h_size)
-    need = h_size * p_size
+    p_size = p_size or (len(devices) // (h_size * d_size))
+    need = h_size * p_size * d_size
     if need > len(devices):
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    grid = np.array(devices[:need]).reshape(h_size, p_size)
-    return Mesh(grid, ("h", "p"))
+    grid = np.array(devices[:need]).reshape(h_size, p_size, d_size)
+    return Mesh(grid, ("h", "p", "d"))
 
 
 def shard_participants_hybrid(array, mesh):
-    """(P, dim) participants sharded over both host and chip axes."""
+    """(P, dim) sharded: participants over host+chip axes, dim over d."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return jax.device_put(array, NamedSharding(mesh, P(("h", "p"), None)))
+    return jax.device_put(array, NamedSharding(mesh, P(("h", "p"), "d")))
 
 
 def hierarchical_clerk_sums(scheme, dim: int, mesh):
@@ -107,24 +111,33 @@ def hierarchical_clerk_sums(scheme, dim: int, mesh):
 
     agg = TpuAggregator(scheme, dim, mesh=mesh)
     plan = agg.plan
+    d_size = mesh.shape.get("d", 1)
+    if d_size > 1 and dim % (plan.input_size * d_size) != 0:
+        # with a sharded dim axis every d-shard must hold whole batches;
+        # unsharded (d=1) keeps the usual zero-pad/truncate tail handling
+        raise ValueError(
+            f"dim {dim} must divide over input_size {plan.input_size} x "
+            f"d={d_size} so every d-shard holds whole batches"
+        )
     import jax.numpy as jnp
 
+    from .engine import fold_mesh_axes
+
     def local_step(secrets, key):
-        # distinct randomness per device: fold in both mesh coordinates
-        key = jax.random.fold_in(key, lax.axis_index("h"))
-        key = jax.random.fold_in(key, lax.axis_index("p"))
+        key = fold_mesh_axes(key, mesh)
         shares = share_participants(secrets, key, plan, False)
         partial = lax.rem(clerk_combine(shares), jnp.int64(plan.modulus))
         partial = lax.rem(lax.psum(partial, axis_name="p"), jnp.int64(plan.modulus))
-        # DCN stage: (n, B) int64 per host — KBs, independent of P
+        # DCN stage: (n, B_local) int64 per host — KBs, independent of P
         total = lax.psum(partial, axis_name="h")
         return lax.rem(total, jnp.int64(plan.modulus))
 
+    d_spec = "d" if "d" in mesh.axis_names else None
     mapped = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(("h", "p"), None), P()),
-        out_specs=P(),
+        in_specs=(P(("h", "p"), d_spec), P()),
+        out_specs=P(None, d_spec),  # clerk sums replicated; B stays d-sharded
         check_vma=False,
     )
     return agg, jax.jit(mapped)

@@ -50,9 +50,34 @@ def test_hierarchical_sum_matches_plaintext():
 
 def test_hybrid_mesh_shapes():
     mesh = make_hybrid_mesh(h_size=2, p_size=4)
-    assert mesh.shape == {"h": 2, "p": 4}
+    assert mesh.shape == {"h": 2, "p": 4, "d": 1}
     mesh1 = make_hybrid_mesh(h_size=1, p_size=8)
-    assert mesh1.shape == {"h": 1, "p": 8}
+    assert mesh1.shape == {"h": 1, "p": 8, "d": 1}
+    mesh3 = make_hybrid_mesh(h_size=2, p_size=2, d_size=2)
+    assert mesh3.shape == {"h": 2, "p": 2, "d": 2}
+
+
+def test_hierarchical_sum_with_dim_axis():
+    """Three-axis hybrid mesh (2 hosts x 2 chips x 2 dim shards): the
+    dim/batch axis (sequence-parallel analog) stays sharded through the
+    clerk sums; the aggregate must still equal the plaintext sum."""
+    import jax
+    import jax.numpy as jnp
+
+    scheme = _scheme()
+    mesh = make_hybrid_mesh(h_size=2, p_size=2, d_size=2)
+    dim = scheme.secret_count * 2 * 3  # divisible by k * d_size
+    secrets = np.random.default_rng(4).integers(
+        0, scheme.prime_modulus, size=(8, dim)
+    )
+    _, step = hierarchical_secure_sum(scheme, dim, mesh)
+    out, plain = step(
+        shard_participants_hybrid(jnp.asarray(secrets), mesh), jax.random.key(2)
+    )
+    np.testing.assert_array_equal(
+        positive(np.asarray(out), scheme.prime_modulus),
+        secrets.sum(axis=0) % scheme.prime_modulus,
+    )
 
 
 def test_hierarchical_sum_generated_params():
@@ -76,3 +101,27 @@ def test_hierarchical_sum_generated_params():
     np.testing.assert_array_equal(
         positive(np.asarray(out), p), secrets.sum(axis=0) % p
     )
+
+
+def test_fold_mesh_axes_distinct_per_device():
+    """Every device must derive a distinct PRNG key (folding only one mesh
+    axis would reuse share randomness across dim shards — a zero-privacy
+    failure when shares differ only in the d coordinate)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from sda_tpu.parallel import make_mesh
+    from sda_tpu.parallel.engine import fold_mesh_axes
+
+    mesh = make_mesh(p_size=4, d_size=2)
+
+    def per_device(key):
+        return jax.random.key_data(fold_mesh_axes(key, mesh))[None]
+
+    keys = jax.shard_map(
+        per_device, mesh=mesh, in_specs=P(), out_specs=P(("p", "d")),
+        check_vma=False,
+    )(jax.random.key(0))
+    rows = {tuple(np.asarray(k)) for k in keys}
+    assert len(rows) == 8, "mesh devices derived colliding PRNG keys"
