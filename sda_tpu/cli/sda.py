@@ -112,6 +112,14 @@ def require_agent(agent):
     return agent
 
 
+def _verify_sharing(scheme) -> None:
+    """Rank-based privacy/reconstruction check (ops.verify_scheme) on every
+    CLI-constructed Shamir scheme — committee-sized, so it is cheap."""
+    from ..ops import verify_scheme
+
+    verify_scheme(scheme)
+
+
 def cmd_aggregations_create(client, args) -> None:
     modulus = args.modulus
     if args.sharing == "add":
@@ -127,6 +135,7 @@ def cmd_aggregations_create(client, args) -> None:
         sharing = BasicShamirSharing(
             share_count=args.share_count, privacy_threshold=t, prime_modulus=modulus
         )
+        _verify_sharing(sharing)
     else:
         from ..ops import find_packed_parameters
 
@@ -139,6 +148,7 @@ def cmd_aggregations_create(client, args) -> None:
             log.warning("modulus %d unsuitable for packed Shamir; using prime %d", modulus, p)
             modulus = p
         sharing = PackedShamirSharing(k, args.share_count, t, p, w2, w3)
+        _verify_sharing(sharing)
     mask = {
         "none": NoMasking(),
         "full": FullMasking(modulus=modulus),

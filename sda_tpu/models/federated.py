@@ -143,6 +143,9 @@ class QuantizationSpec:
             omega_secrets=w2,
             omega_shares=w3,
         )
+        from ..ops import verify_scheme
+
+        verify_scheme(scheme)  # rank-based t-privacy + reconstruction proof
         return cls(p, frac_bits, clip, n_participants), scheme
 
     def quantize(self, flat: np.ndarray) -> np.ndarray:

@@ -29,6 +29,7 @@ from .params import (
     is_prime,
     validate_packed_parameters,
 )
+from .shamir import verify_scheme
 from .rng import uniform_mod_host
 
 __all__ = [
@@ -45,4 +46,5 @@ __all__ = [
     "element_order",
     "find_packed_parameters",
     "validate_packed_parameters",
+    "verify_scheme",
 ]

@@ -279,3 +279,28 @@ def test_chacha_batch_expand_high_rejection_modulus():
     want = np.stack([chacha.expand_seed(s, dim, m) for s in seeds])
     got = np.asarray(chacha_pallas.expand_seeds_batch(jnp.asarray(seeds), dim, m))
     np.testing.assert_array_equal(got, want)
+
+
+def test_verify_scheme_accepts_valid_and_rejects_degenerate(monkeypatch):
+    """verify_scheme proves t-privacy (every t-subset of share rows fully
+    randomized) and universal reconstruction for real schemes, and flags a
+    doctored share matrix whose randomness block is rank-deficient."""
+    from sda_tpu.ops import shamir as shamir_mod
+    from sda_tpu.ops.shamir import verify_scheme
+    from sda_tpu.protocol import BasicShamirSharing
+
+    # reference-verified packed vector + generated params + basic
+    verify_scheme(PackedShamirSharing(3, 8, 4, 433, 354, 150))
+    p, w2, w3 = find_packed_parameters(5, 2, 8, min_modulus_bits=30, seed=0)
+    verify_scheme(PackedShamirSharing(5, 8, 2, p, w2, w3))
+    verify_scheme(BasicShamirSharing(share_count=6, privacy_threshold=3, prime_modulus=433))
+
+    # doctored: zero out one share row's randomness block -> that "clerk"
+    # sees a deterministic function of the secrets
+    scheme = BasicShamirSharing(share_count=4, privacy_threshold=2, prime_modulus=433)
+    good = shamir_mod.share_matrix(scheme)
+    bad = good.copy()
+    bad[1, 1:] = 0
+    monkeypatch.setattr(shamir_mod, "share_matrix", lambda s: bad)
+    with pytest.raises(ValueError, match="t-privacy violated"):
+        verify_scheme(scheme)
