@@ -17,12 +17,15 @@ from .federated import (
     quantize_update,
     unflatten_pytree,
 )
+from .statistics import SecureHistogram, SecureStatistics
 from .trainer import FederatedTrainer
 
 __all__ = [
     "FederatedAveraging",
     "FederatedTrainer",
     "QuantizationSpec",
+    "SecureHistogram",
+    "SecureStatistics",
     "dequantize_mean",
     "flatten_pytree",
     "quantize_update",

@@ -272,13 +272,18 @@ class FederatedAveraging:
         """Recipient: freeze participations + enqueue clerking jobs."""
         recipient.end_aggregation(aggregation_id)
 
-    def finish_round(self, recipient, aggregation_id, n_submitted: int):
-        """Recipient: reveal (after clerking) and return the mean pytree.
+    def reveal_field_sum(self, recipient, aggregation_id, n_submitted: int):
+        """Recipient: reveal and return the raw ``(dim,)`` int64 field sum.
 
         Call after ``close_round`` and after enough clerks drained their
-        queues; raises if no snapshot is ``result_ready`` yet, or if more
-        updates were summed than the field was sized for (the revealed
-        sum would have wrapped — unrecoverable, so fail loudly)."""
+        queues; raises if no snapshot is ``result_ready`` yet, if nothing
+        was submitted (there is no meaningful sum), or if more updates were
+        summed than the field was sized for (the revealed sum would have
+        wrapped — unrecoverable, so fail loudly). Exact integer consumers
+        (e.g. histograms) use this directly; ``finish_round`` dequantizes.
+        """
+        if n_submitted <= 0:
+            raise ValueError("no updates were submitted; nothing to reveal")
         status = recipient.service.get_aggregation_status(
             recipient.agent, aggregation_id
         )
@@ -290,7 +295,11 @@ class FederatedAveraging:
                 f"the round with a spec fitted for the larger cohort"
             )
         output = recipient.reveal_aggregation(aggregation_id)
-        field_sum = np.asarray(output.positive().values, dtype=np.int64)
+        return np.asarray(output.positive().values, dtype=np.int64)
+
+    def finish_round(self, recipient, aggregation_id, n_submitted: int):
+        """Recipient: reveal (after clerking) and return the mean pytree."""
+        field_sum = self.reveal_field_sum(recipient, aggregation_id, n_submitted)
         return dequantize_mean(
             field_sum, n_submitted, self.spec, self.treedef, self.shapes
         )

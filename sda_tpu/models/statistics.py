@@ -1,0 +1,131 @@
+"""Secure statistics over the aggregation protocol: mean, variance,
+histograms — computed across participants without revealing any
+individual's data.
+
+These are the classic federated-analytics queries; like model averaging
+(federated.py) they reduce to secure sums:
+
+- **mean / variance**: each participant submits ``[x, x**2]`` per
+  coordinate; the revealed sums give ``E[x]`` and ``E[x**2]``, hence
+  ``Var[x] = E[x**2] - E[x]**2``. The protocol is exact in the field, so
+  the only error is fixed-point quantization.
+- **histogram**: each participant one-hot encodes its values into bin
+  counts; the revealed sum IS the cohort histogram. Counts are integers
+  (``frac_bits=0``), so results are exact.
+
+Both ride the ``FederatedAveraging`` round driver (open / submit /
+close / finish) — a statistics query is just a FedAvg round over a
+derived "model" — and therefore inherit masking, packed-Shamir sharing,
+sealed transport, dropout tolerance, and the rank-verified schemes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .federated import FederatedAveraging, QuantizationSpec
+
+
+class SecureStatistics:
+    """Cohort mean + variance of ``(dim,)`` float vectors, privately.
+
+    ``clip`` bounds each |coordinate|; squares are bounded by ``clip**2``,
+    so the shared quantization spec is fitted to ``max(clip, clip**2)``.
+    """
+
+    def __init__(self, dim: int, clip: float, n_participants: int, frac_bits: int = 16):
+        self.dim = dim
+        self.clip = clip
+        bound = max(clip, clip * clip)
+        self.spec, self.sharing = QuantizationSpec.fitted(
+            frac_bits, bound, n_participants
+        )
+        template = {"sum": np.zeros(dim), "sumsq": np.zeros(dim)}
+        self.fed = FederatedAveraging(self.spec, template)
+
+    def open_round(self, recipient, recipient_key):
+        return self.fed.open_round(
+            recipient, recipient_key, self.sharing, title="secure-statistics"
+        )
+
+    def submit(self, participant, aggregation_id, values) -> None:
+        values = np.asarray(values, dtype=np.float64)
+        if values.shape != (self.dim,):
+            raise ValueError(f"expected ({self.dim},) values, got {values.shape}")
+        if np.abs(values).max(initial=0.0) > self.clip:
+            raise ValueError(f"values exceed clip bound {self.clip}")
+        self.fed.submit_update(
+            participant, aggregation_id, {"sum": values, "sumsq": values * values}
+        )
+
+    def close_round(self, recipient, aggregation_id) -> None:
+        self.fed.close_round(recipient, aggregation_id)
+
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """-> {"count", "mean", "variance"} (population variance)."""
+        means = self.fed.finish_round(recipient, aggregation_id, n_submitted)
+        mean = means["sum"]
+        variance = np.maximum(means["sumsq"] - mean * mean, 0.0)
+        return {"count": n_submitted, "mean": mean, "variance": variance}
+
+
+class SecureHistogram:
+    """Cohort histogram over ``bins`` equal-width bins of ``[lo, hi)``.
+
+    Each participant may contribute many values; it submits its *local*
+    bin counts (integers, ``frac_bits=0`` — exact), bounded by
+    ``max_values_per_participant``. Out-of-range values clamp to the edge
+    bins (the usual federated-analytics convention, and it keeps the
+    submitted count equal to the number of values).
+    """
+
+    def __init__(
+        self,
+        bins: int,
+        lo: float,
+        hi: float,
+        n_participants: int,
+        max_values_per_participant: int = 1 << 20,
+    ):
+        if not (bins > 0 and hi > lo):
+            raise ValueError("need bins > 0 and hi > lo")
+        self.bins = bins
+        self.lo, self.hi = float(lo), float(hi)
+        self.max_values = max_values_per_participant
+        self.spec, self.sharing = QuantizationSpec.fitted(
+            0, float(max_values_per_participant), n_participants
+        )
+        self.fed = FederatedAveraging(self.spec, {"counts": np.zeros(bins)})
+
+    def local_counts(self, values) -> np.ndarray:
+        values = np.asarray(values, dtype=np.float64).reshape(-1)
+        if len(values) > self.max_values:
+            raise ValueError(f"more than {self.max_values} values")
+        if not np.isfinite(values).all():
+            raise ValueError("values contain non-finite entries (NaN/inf)")
+        ixf = np.floor((values - self.lo) / (self.hi - self.lo) * self.bins)
+        # clamp BEFORE the int cast: a huge float would overflow int64 to
+        # INT64_MIN and land a value above hi in the LOWEST bin
+        ix = np.clip(ixf, 0, self.bins - 1).astype(np.int64)
+        return np.bincount(ix, minlength=self.bins).astype(np.float64)
+
+    def open_round(self, recipient, recipient_key):
+        return self.fed.open_round(
+            recipient, recipient_key, self.sharing, title="secure-histogram"
+        )
+
+    def submit(self, participant, aggregation_id, values) -> None:
+        self.fed.submit_update(
+            participant, aggregation_id, {"counts": self.local_counts(values)}
+        )
+
+    def close_round(self, recipient, aggregation_id) -> None:
+        self.fed.close_round(recipient, aggregation_id)
+
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> np.ndarray:
+        """-> (bins,) int64 exact cohort counts.
+
+        Counts are read straight off the integer field sum (frac_bits=0,
+        counts nonnegative and wraparound-guarded, so the residues ARE the
+        counts) — no float round trip, exact for any permitted cohort."""
+        return self.fed.reveal_field_sum(recipient, aggregation_id, n_submitted)

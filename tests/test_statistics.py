@@ -1,0 +1,101 @@
+"""Secure statistics (models/statistics.py): mean/variance and histograms
+through the full protocol, with exact assertions where the math is exact."""
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, with_service
+from sda_tpu.models.statistics import SecureHistogram, SecureStatistics
+
+
+def _setup(ctx, tmp_path):
+    recipient = new_client(tmp_path / "r", ctx.service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(8)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    return recipient, rkey, clerks
+
+
+def test_secure_mean_variance(tmp_path):
+    dim, n = 16, 5
+    stats = SecureStatistics(dim=dim, clip=4.0, n_participants=8, frac_bits=20)
+    rng = np.random.default_rng(0)
+    data = rng.uniform(-4, 4, size=(n, dim))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = stats.open_round(recipient, rkey)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            stats.submit(part, agg_id, data[i])
+        stats.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = stats.finish(recipient, agg_id, n)
+
+    assert result["count"] == n
+    tol = n / stats.spec.scale  # quantization only
+    np.testing.assert_allclose(result["mean"], data.mean(axis=0), atol=tol)
+    np.testing.assert_allclose(result["variance"], data.var(axis=0), atol=20 * tol)
+
+
+def test_secure_statistics_rejects_out_of_bounds():
+    stats = SecureStatistics(dim=4, clip=1.0, n_participants=2, frac_bits=8)
+    with pytest.raises(ValueError, match="clip bound"):
+        stats.submit(object(), object(), np.array([0.0, 2.0, 0.0, 0.0]))
+    with pytest.raises(ValueError, match="expected"):
+        stats.submit(object(), object(), np.zeros(5))
+
+
+def test_secure_histogram_exact(tmp_path):
+    hist = SecureHistogram(bins=6, lo=0.0, hi=6.0, n_participants=4)
+    datasets = [
+        np.array([0.5, 1.5, 1.7, 5.9, -3.0]),   # -3 clamps to bin 0
+        np.array([2.2, 2.8, 9.0]),              # 9 clamps to bin 5
+        np.array([4.4]),
+    ]
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = hist.open_round(recipient, rkey)
+        for i, vals in enumerate(datasets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            hist.submit(part, agg_id, vals)
+        hist.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        counts = hist.finish(recipient, agg_id, len(datasets))
+
+    want = sum(hist.local_counts(v) for v in datasets).astype(np.int64)
+    np.testing.assert_array_equal(counts, want)  # integer counts: exact
+    assert counts.sum() == sum(len(v) for v in datasets)
+
+
+def test_histogram_local_counts_clamping():
+    hist = SecureHistogram(bins=3, lo=0.0, hi=3.0, n_participants=2)
+    np.testing.assert_array_equal(
+        hist.local_counts([-5.0, 0.5, 1.5, 2.9, 99.0]), [2, 1, 2]
+    )
+
+
+def test_histogram_rejects_nonfinite_and_clamps_huge():
+    hist = SecureHistogram(bins=3, lo=0.0, hi=3.0, n_participants=2)
+    with pytest.raises(ValueError, match="non-finite"):
+        hist.local_counts([np.nan])
+    # a value overflowing the int64 bin index must clamp to the TOP bin
+    np.testing.assert_array_equal(hist.local_counts([1e300]), [0, 0, 1])
+    np.testing.assert_array_equal(hist.local_counts([-1e300]), [1, 0, 0])
+
+
+def test_finish_rejects_zero_submissions():
+    from sda_tpu.models import FederatedAveraging, QuantizationSpec
+
+    spec, _ = QuantizationSpec.fitted(frac_bits=8, clip=1.0, n_participants=2)
+    fed = FederatedAveraging(spec, {"w": np.zeros(2)})
+    with pytest.raises(ValueError, match="nothing to reveal"):
+        fed.finish_round(object(), object(), 0)
