@@ -26,7 +26,9 @@ from sda_tpu.protocol import (
 PACKED_433 = PackedShamirSharing(3, 8, 4, 433, 354, 150)
 
 
-def _random_round(seed: int, tmp_path, kind=None, dim=None, n_participants=None):
+def _random_round(
+    seed: int, tmp_path, kind=None, dim=None, n_participants=None, keep_min=False
+):
     rng = np.random.default_rng(seed)
     if dim is None:
         dim = int(rng.integers(1, 41))
@@ -90,7 +92,7 @@ def _random_round(seed: int, tmp_path, kind=None, dim=None, n_participants=None)
         committee = ctx.service.get_committee(recipient.agent, agg.id)
         member_ids = [cid for cid, _ in committee.clerks_and_keys]
         need = sharing.reconstruction_threshold
-        keep = int(rng.integers(need, len(member_ids) + 1))
+        keep = need if keep_min else int(rng.integers(need, len(member_ids) + 1))
         chosen = list(rng.choice(len(member_ids), size=keep, replace=False))
         workers = {c.agent.id: c for c in [recipient] + members}
         for ix in chosen:
@@ -117,6 +119,14 @@ def test_every_scheme_kind_runs(kind, tmp_path):
     """Stratified: force each scheme kind (the pure-random draw above may
     skip one for a given seed range)."""
     _random_round(100, tmp_path, kind=kind)
+
+
+@pytest.mark.parametrize("kind", ["basic", "packed", "packed_gen"])
+def test_minimal_reconstruction_subset(kind, tmp_path):
+    """Force reveal from EXACTLY reconstruction_threshold results — the
+    dropout boundary (an off-by-one that extra shares would mask fails
+    here). basic: t+1 of n; packed: t+k of n."""
+    _random_round(300, tmp_path, kind=kind, keep_min=True)
 
 
 @pytest.mark.parametrize("dim,n_participants", [(1, 1), (1, 3), (3, 1)])
