@@ -10,7 +10,8 @@ at once).
 from __future__ import annotations
 
 from ..crypto import signing
-from ..protocol import ClerkingResult
+from ..ops.modular import positive
+from ..protocol import PackedPaillierEncryptionScheme, ClerkingResult
 from ..utils.metrics import get_metrics
 
 
@@ -62,6 +63,13 @@ class Clerking:
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
         with metrics.phase("clerk.combine"):
             combined = combiner.combine(share_vectors)
+        if isinstance(
+            aggregation.recipient_encryption_scheme, PackedPaillierEncryptionScheme
+        ):
+            # Paillier packing is nonnegative-only; lift the signed
+            # residues (truncated-remainder semantics) to canonical form —
+            # congruent mod m, so reconstruction is unchanged
+            combined = positive(combined, aggregation.modulus)
 
         # fetch + verify recipient key, re-encrypt the combined vector
         recipient = self.service.get_agent(self.agent, aggregation.recipient)

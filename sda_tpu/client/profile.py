@@ -13,6 +13,10 @@ class Maintenance:
         """Create a new encryption keypair in the keystore; returns its id."""
         return self.crypto.new_encryption_key()
 
+    def new_paillier_encryption_key(self, modulus_bits: int = 2048):
+        """Create a Paillier keypair in the keystore; returns its id."""
+        return self.crypto.new_paillier_encryption_key(modulus_bits)
+
     def upload_encryption_key(self, key_id) -> None:
         """Sign the public key with the agent's signature key and upload."""
         signed = self.crypto.sign_encryption_key(self.agent, key_id)

@@ -13,7 +13,16 @@ from __future__ import annotations
 import numpy as np
 
 from .. import native
-from ..protocol import B32, Binary, Encryption, EncryptionKey, SodiumEncryptionScheme
+from ..protocol import (
+    B32,
+    Binary,
+    Encryption,
+    EncryptionKey,
+    PackedPaillierEncryptionScheme,
+    PaillierEncryptionKey,
+    SodiumEncryptionScheme,
+)
+from ..ops import paillier
 from . import sodium, varint
 from .keystore import DecryptionKey, EncryptionKeypair
 
@@ -26,6 +35,11 @@ class ShareEncryptor:
 class ShareDecryptor:
     def decrypt(self, encryption: Encryption) -> np.ndarray:
         raise NotImplementedError
+
+    def decrypt_batch(self, encryptions) -> list:
+        """Default batch: a plain loop (sodium overrides with one native
+        batched call)."""
+        return [self.decrypt(e) for e in encryptions]
 
 
 class SodiumEncryptor(ShareEncryptor):
@@ -67,13 +81,117 @@ def generate_encryption_keypair() -> EncryptionKeypair:
     return EncryptionKeypair(ek=EncryptionKey(B32(pk)), dk=DecryptionKey(B32(sk)))
 
 
+class PaillierEncryptor(ShareEncryptor):
+    """Packed-Paillier encryption of nonnegative bounded value vectors.
+
+    Wire format of one Encryption: fixed-width big-endian ciphertext
+    blocks (2 * key bytes each, since c < n^2), concatenated — the block
+    width is derivable from the public key on both sides. Values must be
+    canonical nonnegative residues below 2^max_value_bitsize (the mask
+    path guarantees this; shares can be negative and stay on sodium).
+    """
+
+    def __init__(self, ek: PaillierEncryptionKey, scheme: PackedPaillierEncryptionScheme):
+        if not isinstance(ek, PaillierEncryptionKey):
+            raise TypeError("PackedPaillier scheme requires a Paillier public key")
+        if ek.n.bit_length() < scheme.min_modulus_bitsize:
+            raise ValueError("Paillier key smaller than the scheme's minimum")
+        self.pk = paillier.PaillierPublicKey(ek.n)
+        self.packing = paillier.Packing(
+            scheme.component_count, scheme.component_bitsize, scheme.max_value_bitsize
+        )
+        self.block_bytes = 2 * ((ek.n.bit_length() + 7) // 8)
+
+    def encrypt(self, shares):
+        values = [int(v) for v in np.asarray(shares, dtype=np.int64)]
+        if any(v < 0 for v in values):
+            raise ValueError("Paillier packing requires nonnegative values")
+        blocks = paillier.encrypt_vector(self.pk, self.packing, values)
+        # 4-byte value-count header: block padding must not change the
+        # vector length on the way back through decrypt
+        raw = len(values).to_bytes(4, "big") + b"".join(
+            c.to_bytes(self.block_bytes, "big") for c in blocks
+        )
+        return Encryption(Binary(raw))
+
+
+class PaillierDecryptor(ShareDecryptor):
+    def __init__(self, keypair, scheme: PackedPaillierEncryptionScheme):
+        self.sk = paillier.PaillierPrivateKey(keypair.ek.n, keypair.lam, keypair.mu)
+        self.packing = paillier.Packing(
+            scheme.component_count, scheme.component_bitsize, scheme.max_value_bitsize
+        )
+        self.block_bytes = 2 * ((keypair.ek.n.bit_length() + 7) // 8)
+
+    def decrypt(self, encryption):
+        raw = bytes(encryption.inner)
+        count, raw = int.from_bytes(raw[:4], "big"), raw[4:]
+        if len(raw) % self.block_bytes:
+            raise ValueError("ciphertext length not a multiple of the block width")
+        blocks = [
+            int.from_bytes(raw[i : i + self.block_bytes], "big")
+            for i in range(0, len(raw), self.block_bytes)
+        ]
+        values = paillier.decrypt_vector(self.sk, self.packing, blocks, count)
+        # component_bitsize <= 62 (scheme invariant): sums fit int64
+        return np.asarray(values, dtype=np.int64)
+
+
+def combine_encryptions(ek, scheme, encryptions: list) -> "Encryption":
+    """Homomorphic server-side combine: product of ciphertext blocks ==
+    encryption of the componentwise sum. Public-key only — callable by the
+    untrusted server. All inputs must have identical block counts (same
+    vector dimension), and the caller bounds how many are combined
+    (scheme additions capacity)."""
+    if not isinstance(ek, PaillierEncryptionKey):
+        raise TypeError("combine requires a Paillier public key")
+    pk = paillier.PaillierPublicKey(ek.n)
+    block_bytes = 2 * ((ek.n.bit_length() + 7) // 8)
+
+    def blocks_of(e):
+        raw = bytes(e.inner)
+        count, raw = int.from_bytes(raw[:4], "big"), raw[4:]
+        if len(raw) % block_bytes:
+            raise ValueError("ciphertext length not a multiple of the block width")
+        return count, [
+            int.from_bytes(raw[i : i + block_bytes], "big")
+            for i in range(0, len(raw), block_bytes)
+        ]
+
+    combined, count0 = None, None
+    for e in encryptions:
+        count, b = blocks_of(e)
+        if combined is None:
+            combined, count0 = b, count
+        else:
+            if count != count0:
+                raise ValueError("mismatched vector lengths in combine")
+            combined = paillier.add_vectors(pk, combined, b)
+    raw = count0.to_bytes(4, "big") + b"".join(
+        c.to_bytes(block_bytes, "big") for c in combined
+    )
+    return Encryption(Binary(raw))
+
+
+def generate_paillier_keypair(modulus_bits: int = 2048):
+    """-> keystore.PaillierKeypair with fresh primes."""
+    from .keystore import PaillierKeypair
+
+    pk, sk = paillier.keygen(modulus_bits)
+    return PaillierKeypair(ek=PaillierEncryptionKey(pk.n), lam=sk.lam, mu=sk.mu)
+
+
 def new_share_encryptor(ek: EncryptionKey, scheme) -> ShareEncryptor:
     if isinstance(scheme, SodiumEncryptionScheme):
         return SodiumEncryptor(ek)
+    if isinstance(scheme, PackedPaillierEncryptionScheme):
+        return PaillierEncryptor(ek, scheme)
     raise TypeError(f"unknown encryption scheme {scheme!r}")
 
 
 def new_share_decryptor(keypair: EncryptionKeypair, scheme) -> ShareDecryptor:
     if isinstance(scheme, SodiumEncryptionScheme):
         return SodiumDecryptor(keypair)
+    if isinstance(scheme, PackedPaillierEncryptionScheme):
+        return PaillierDecryptor(keypair, scheme)
     raise TypeError(f"unknown encryption scheme {scheme!r}")

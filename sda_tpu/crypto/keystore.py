@@ -13,7 +13,7 @@ from dataclasses import dataclass
 
 from ..utils.jsondir import JsonDir
 
-from ..protocol import B32, B64
+from ..protocol import PaillierEncryptionKey, B32, B64
 from ..protocol.schemes import EncryptionKey, SigningKey, VerificationKey, _untag
 
 
@@ -46,8 +46,36 @@ class EncryptionKeypair:
 
     @classmethod
     def from_json(cls, obj):
+        dk = obj["dk"]
+        if isinstance(dk, dict) and "Paillier" in dk:
+            return PaillierKeypair.from_json(obj)
         return cls(
             ek=EncryptionKey.from_json(obj["ek"]), dk=DecryptionKey.from_json(obj["dk"])
+        )
+
+
+@dataclass
+class PaillierKeypair:
+    """Paillier keypair: public n, private (lam, mu) — the PackedPaillier
+    extension's key material, stored alongside sodium pairs."""
+
+    ek: "PaillierEncryptionKey"
+    lam: int
+    mu: int
+
+    def to_json(self):
+        return {
+            "ek": self.ek.to_json(),
+            "dk": {"Paillier": {"lam": str(self.lam), "mu": str(self.mu)}},
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        dk = obj["dk"]["Paillier"]
+        return cls(
+            ek=PaillierEncryptionKey.from_json(obj["ek"]),
+            lam=int(dk["lam"]),
+            mu=int(dk["mu"]),
         )
 
 

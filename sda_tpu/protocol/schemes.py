@@ -84,6 +84,31 @@ class EncryptionKey(_SodiumNewtype):
 
     INNER = B32
 
+    @classmethod
+    def from_json(cls, obj):
+        # polymorphic: sodium keys are {"Sodium": b64}; Paillier public
+        # keys (the sketched PackedPaillier extension) are
+        # {"Paillier": {"n": decimal}} — both usable wherever a key goes
+        tag, payload = _untag(obj, ("Sodium", "Paillier"))
+        if tag == "Paillier":
+            return PaillierEncryptionKey(int(payload["n"]))
+        return cls(B32.from_json(payload))
+
+
+@dataclass(frozen=True)
+class PaillierEncryptionKey:
+    """Paillier public key: the modulus n (g is fixed to n+1)."""
+
+    n: int
+
+    def to_json(self):
+        return {"Paillier": {"n": str(self.n)}}
+
+    @classmethod
+    def from_json(cls, obj):
+        _, payload = _untag(obj, ("Paillier",))
+        return cls(int(payload["n"]))
+
 
 class Signature(_SodiumNewtype):
     """Ed25519 detached signature (64 bytes)."""
@@ -349,7 +374,14 @@ class AdditiveEncryptionScheme:
 
     @staticmethod
     def from_json(obj):
-        tag, _ = _untag(obj, ("Sodium",))
+        tag, payload = _untag(obj, ("Sodium", "PackedPaillier"))
+        if tag == "PackedPaillier":
+            return PackedPaillierEncryptionScheme(
+                component_count=int(payload["component_count"]),
+                component_bitsize=int(payload["component_bitsize"]),
+                max_value_bitsize=int(payload["max_value_bitsize"]),
+                min_modulus_bitsize=int(payload["min_modulus_bitsize"]),
+            )
         return SodiumEncryptionScheme()
 
 
@@ -362,3 +394,45 @@ class SodiumEncryptionScheme(AdditiveEncryptionScheme):
 
     def to_json(self):
         return "Sodium"
+
+
+@dataclass(frozen=True)
+class PackedPaillierEncryptionScheme(AdditiveEncryptionScheme):
+    """Packed Paillier transport encryption — additively homomorphic.
+
+    The reference sketches exactly these fields (crypto.rs:164-174) and
+    names Paillier as its scale-up path; here it is implemented. Masks
+    encrypted under this scheme can be combined BY THE SERVER (ciphertext
+    multiplication), so the recipient decrypts one ciphertext per
+    component block regardless of participant count. Up to
+    ``2^(component_bitsize - max_value_bitsize)`` ciphertexts may be
+    combined before a component could carry into its neighbor.
+    """
+
+    component_count: int
+    component_bitsize: int
+    max_value_bitsize: int
+    min_modulus_bitsize: int
+
+    def __post_init__(self):
+        if self.max_value_bitsize > self.component_bitsize:
+            raise ValueError("component values larger than their slots")
+        if self.component_bitsize > 62:
+            # decrypted component sums must fit the i64 share plane
+            raise ValueError("component_bitsize must be <= 62")
+        if self.component_count * self.component_bitsize >= self.min_modulus_bitsize:
+            raise ValueError("components do not fit the plaintext space")
+
+    def batch_size(self) -> int:
+        return self.component_count
+
+    def to_json(self):
+        return _tagged(
+            "PackedPaillier",
+            {
+                "component_count": self.component_count,
+                "component_bitsize": self.component_bitsize,
+                "max_value_bitsize": self.max_value_bitsize,
+                "min_modulus_bitsize": self.min_modulus_bitsize,
+            },
+        )

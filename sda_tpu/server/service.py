@@ -103,6 +103,31 @@ class SdaServer:
             raise InvalidRequestError(
                 "ChaCha masking dimension differs from aggregation vector dimension"
             )
+        from ..protocol import FullMasking, PackedPaillierEncryptionScheme
+
+        if isinstance(
+            aggregation.committee_encryption_scheme, PackedPaillierEncryptionScheme
+        ):
+            # shares are signed residues (truncated-remainder semantics);
+            # Paillier packing is nonnegative-only, so clerk transport
+            # stays on sodium sealed boxes
+            raise InvalidRequestError(
+                "PackedPaillier applies to recipient encryption only"
+            )
+        if isinstance(
+            aggregation.recipient_encryption_scheme, PackedPaillierEncryptionScheme
+        ):
+            pscheme = aggregation.recipient_encryption_scheme
+            if not isinstance(masking, (FullMasking,)) and masking.has_mask():
+                # ChaCha uploads SEEDS as masks — summing seeds
+                # homomorphically would corrupt the unmask silently
+                raise InvalidRequestError(
+                    "PackedPaillier recipient encryption requires Full masking"
+                )
+            if aggregation.modulus.bit_length() > pscheme.max_value_bitsize:
+                raise InvalidRequestError(
+                    "mask values would not fit the Paillier component bound"
+                )
         self.aggregation_store.create_aggregation(aggregation)
 
     def delete_aggregation(self, aggregation_id) -> None:
@@ -111,7 +136,21 @@ class SdaServer:
     def suggest_committee(self, aggregation_id):
         if self.aggregation_store.get_aggregation(aggregation_id) is None:
             raise ServerError("aggregation not found")
-        return self.agents_store.suggest_committee()
+        from ..protocol import EncryptionKey
+
+        # clerk transport is sodium sealed boxes; a candidate whose only
+        # published key is e.g. a Paillier recipient key cannot receive
+        # shares — offer only sodium-capable keys (and drop keyless agents)
+        candidates = []
+        for cand in self.agents_store.suggest_committee():
+            sodium_keys = []
+            for key_id in cand.keys:
+                signed = self.agents_store.get_encryption_key(key_id)
+                if signed is not None and isinstance(signed.body.body, EncryptionKey):
+                    sodium_keys.append(key_id)
+            if sodium_keys:
+                candidates.append(type(cand)(id=cand.id, keys=sodium_keys))
+        return candidates
 
     def create_committee(self, committee) -> None:
         agg = self.aggregation_store.get_aggregation(committee.aggregation)

@@ -80,6 +80,9 @@ def run_snapshot(server, snapshot) -> None:
             if part.recipient_encryption is None:
                 raise ServerError("participation should have had a recipient encryption")
             recipient_encryptions.append(part.recipient_encryption)
+        recipient_encryptions = _maybe_combine_masks(
+            server, aggregation, recipient_encryptions
+        )
         server.aggregation_store.create_snapshot_mask(snapshot.id, recipient_encryptions)
 
     # persisting the snapshot record is the COMMIT POINT: the retry guard
@@ -89,3 +92,39 @@ def run_snapshot(server, snapshot) -> None:
     server.aggregation_store.create_snapshot(snapshot)
 
     log.debug("snapshot %s: done", snapshot.id)
+
+
+def _maybe_combine_masks(server, aggregation, recipient_encryptions):
+    """Homomorphic server-side mask combine (the Paillier scale-up path,
+    reference README "Doing more"): when masks are PackedPaillier-encrypted,
+    multiply all participants' ciphertexts into ONE — the recipient then
+    decrypts O(dim) data regardless of participant count. Public-key only;
+    the untrusted server learns nothing. Falls back to the uncombined list
+    (recipient combines after decrypting, still correct) if the cohort
+    exceeds the packing's addition capacity or the key is unavailable.
+    """
+    from ..protocol import PackedPaillierEncryptionScheme
+
+    scheme = aggregation.recipient_encryption_scheme
+    if not isinstance(scheme, PackedPaillierEncryptionScheme):
+        return recipient_encryptions
+    if len(recipient_encryptions) < 2:
+        return recipient_encryptions
+    capacity = 1 << (scheme.component_bitsize - scheme.max_value_bitsize)
+    if len(recipient_encryptions) > capacity:
+        log.warning(
+            "snapshot: %d participations exceed Paillier addition capacity %d; "
+            "leaving masks uncombined",
+            len(recipient_encryptions),
+            capacity,
+        )
+        return recipient_encryptions
+    signed = server.agents_store.get_encryption_key(aggregation.recipient_key)
+    if signed is None:
+        log.warning("snapshot: recipient key unavailable; leaving masks uncombined")
+        return recipient_encryptions
+    from ..crypto.encryption import combine_encryptions
+
+    with get_metrics().phase("snapshot.paillier_combine"):
+        combined = combine_encryptions(signed.body.body, scheme, recipient_encryptions)
+    return [combined]

@@ -1,0 +1,75 @@
+"""Packed Paillier core (ops/paillier.py): correctness of the cryptosystem,
+the homomorphism, and the packing bounds. Test keys are 512-bit for speed
+(real use is 2048); the math is size-independent."""
+
+import numpy as np
+import pytest
+
+from sda_tpu.ops import paillier
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return paillier.keygen(modulus_bits=512)
+
+
+def test_encrypt_decrypt_roundtrip(keys):
+    pk, sk = keys
+    for m in [0, 1, 12345, pk.n - 1]:
+        assert paillier.decrypt(sk, paillier.encrypt(pk, m)) == m
+    with pytest.raises(ValueError):
+        paillier.encrypt(pk, pk.n)
+
+
+def test_randomized_ciphertexts(keys):
+    pk, _ = keys
+    assert paillier.encrypt(pk, 7) != paillier.encrypt(pk, 7)
+
+
+def test_additive_homomorphism(keys):
+    pk, sk = keys
+    rng = np.random.default_rng(0)
+    total, c = 0, paillier.encrypt(pk, 0)
+    for _ in range(20):
+        m = int(rng.integers(0, 1 << 40))
+        c = paillier.add(pk, c, paillier.encrypt(pk, m))
+        total += m
+    assert paillier.decrypt(sk, c) == total
+
+
+def test_packing_roundtrip_and_bounds():
+    packing = paillier.Packing(component_count=4, component_bitsize=40, max_value_bitsize=32)
+    vals = [0, 1, (1 << 32) - 1, 12345]
+    assert packing.unpack(packing.pack(vals)) == vals
+    assert packing.additions_capacity == 1 << 8
+    with pytest.raises(ValueError, match="outside"):
+        packing.pack([1 << 32])
+    with pytest.raises(ValueError, match="slots"):
+        paillier.Packing(1, 8, 9)
+
+
+def test_vector_homomorphic_sum(keys):
+    """The server-side combine: sum of encrypted vectors decrypts to the
+    componentwise integer sum, with no component carry while within
+    additions_capacity."""
+    pk, sk = keys
+    packing = paillier.Packing(component_count=5, component_bitsize=40, max_value_bitsize=32)
+    rng = np.random.default_rng(1)
+    n_parties, dim = 12, 13  # 12 < 2^8 capacity; dim spans 3 blocks
+    vectors = rng.integers(0, 1 << 32, size=(n_parties, dim), dtype=np.uint64)
+
+    combined = None
+    for vec in vectors:
+        blocks = paillier.encrypt_vector(pk, packing, [int(v) for v in vec])
+        combined = blocks if combined is None else paillier.add_vectors(pk, combined, blocks)
+
+    got = paillier.decrypt_vector(sk, packing, combined, dim)
+    want = vectors.astype(object).sum(axis=0)
+    assert got == [int(w) for w in want]
+
+
+def test_packing_must_fit_key(keys):
+    pk, _ = keys
+    too_big = paillier.Packing(component_count=20, component_bitsize=40, max_value_bitsize=32)
+    with pytest.raises(ValueError, match="fit"):
+        paillier.encrypt_vector(pk, too_big, [1])

@@ -251,3 +251,41 @@ def test_basic_shamir_rejects_degenerate_params():
         LinearSecretSharingScheme.from_json(
             {"BasicShamir": {"share_count": 8, "privacy_threshold": 2, "prime_modulus": 7}}
         )
+
+
+def test_packed_paillier_wire_roundtrip():
+    """PackedPaillier scheme tag + field names match the reference's
+    commented enum variant (crypto.rs:164-174); Paillier public keys ride
+    the EncryptionKey slot polymorphically."""
+    from sda_tpu.protocol import (
+        AdditiveEncryptionScheme,
+        EncryptionKey,
+        PackedPaillierEncryptionScheme,
+        PaillierEncryptionKey,
+    )
+
+    s = PackedPaillierEncryptionScheme(
+        component_count=10, component_bitsize=40,
+        max_value_bitsize=32, min_modulus_bitsize=2048,
+    )
+    assert s.to_json() == {
+        "PackedPaillier": {
+            "component_count": 10,
+            "component_bitsize": 40,
+            "max_value_bitsize": 32,
+            "min_modulus_bitsize": 2048,
+        }
+    }
+    assert AdditiveEncryptionScheme.from_json(s.to_json()) == s
+
+    key = PaillierEncryptionKey(123456789 * 987654321)
+    assert EncryptionKey.from_json(key.to_json()) == key
+
+    import pytest
+
+    with pytest.raises(ValueError, match="slots"):
+        PackedPaillierEncryptionScheme(10, 30, 32, 2048)
+    with pytest.raises(ValueError, match="62"):
+        PackedPaillierEncryptionScheme(2, 63, 32, 2048)
+    with pytest.raises(ValueError, match="plaintext"):
+        PackedPaillierEncryptionScheme(100, 40, 32, 512)
