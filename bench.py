@@ -121,7 +121,6 @@ def main() -> int:
     chunk = args.chunk
 
     from sda_tpu.ops.modular import mod_sum_wide_jnp
-    from sda_tpu.ops.rng import uniform_mod_device
 
     B = plan.n_batches
     use_limbs = not args.no_limbs or args.wide
@@ -131,7 +130,8 @@ def main() -> int:
         if args.wide:
             return lax.rem(plain + mod_sum_wide_jnp(secrets, p, axis=0), jnp.int64(p))
         return lax.rem(
-            plain + lax.rem(jnp.sum(secrets, axis=0), jnp.int64(p)), jnp.int64(p)
+            plain + lax.rem(jnp.sum(secrets.astype(jnp.int64), axis=0), jnp.int64(p)),
+            jnp.int64(p),
         )
 
     if args.engine == "sumfirst":
@@ -190,20 +190,38 @@ def main() -> int:
             return got if np.array_equal(got, want) else None
 
     else:
+        from sda_tpu.ops.rng import uniform_bits_device, uniform_bits_device_narrow
         from sda_tpu.parallel.limbmatmul import limb_recombine_host
 
-        W = 2 * limb_count(p) - 1
+        # const-folded limb partials: one weight group per limb of p
+        W = limb_count(p)
         acc_shape = (W, B, n) if use_limbs else (n, B)
+        # same division-free synthetic draws as the sumfirst branch: masked
+        # bits over a power-of-two sub-range (zero modulo bias; the emulated
+        # 64-bit `%` in uniform_mod_device would dominate the pipeline)
+        nbits = p.bit_length() - 1
+        narrow = use_limbs and p <= (1 << 31)
+
+        def draw_bits(key, shape, bits):
+            if narrow:
+                return uniform_bits_device_narrow(key, shape, bits)
+            return uniform_bits_device(key, shape, bits)
+
+        def mask_draw(key, shape, m):
+            return draw_bits(key, shape, m.bit_length() - 1)
 
         def body(carry, i):
             acc, plain, key = carry
             key, sk, rk = jax.random.split(key, 3)
-            secrets = uniform_mod_device(sk, (chunk, dim), p)
+            secrets = draw_bits(sk, (chunk, dim), nbits)
             if use_limbs:
                 # fused limb path: no 64-bit mul/div on the big tensors
-                acc = lax.rem(acc + share_combine_limb(secrets, rk, plan), jnp.int64(p))
+                acc = lax.rem(
+                    acc + share_combine_limb(secrets, rk, plan, draw=mask_draw),
+                    jnp.int64(p),
+                )
             else:
-                shares = share_participants(secrets, rk, plan, False)  # (C, n, B)
+                shares = share_participants(secrets, rk, plan, False, draw=mask_draw)
                 acc = lax.rem(
                     acc + lax.rem(clerk_combine(shares), jnp.int64(p)), jnp.int64(p)
                 )

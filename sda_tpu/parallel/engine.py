@@ -121,31 +121,47 @@ def _device_randomness(key, shape, modulus):
     return uniform_mod_device(key, shape, modulus)
 
 
-def share_participants(secrets, key, plan: AggregationPlan, use_limbs: bool = False):
-    """(P, dim) secrets -> (P, n, B) per-clerk share tensor."""
+def share_participants(
+    secrets, key, plan: AggregationPlan, use_limbs: bool = False, draw=None
+):
+    """(P, dim) secrets -> (P, n, B) per-clerk share tensor.
+
+    ``draw(key, shape, p) -> int in [0, p)`` overrides the randomness
+    generator (benchmarks pass a division-free masked-bits draw; default is
+    the simulation-grade ``uniform_mod_device``).
+    """
     jnp = _jnp()
     from jax import lax
 
+    if draw is None:
+        draw = _device_randomness
     p = plan.modulus
     if plan.share_matrix is None:
         # additive: n-1 uniform draws + closing share (additive.rs:42-48)
         P, d = secrets.shape
-        draws = _device_randomness(key, (P, plan.share_count - 1, d), p)  # (P, n-1, d)
+        draws = draw(key, (P, plan.share_count - 1, d), p)  # (P, n-1, d)
         total = jnp.sum(draws.astype(jnp.int64), axis=1)
         last = lax.rem(secrets.astype(jnp.int64) - total, jnp.int64(p))
         return jnp.concatenate([draws.astype(jnp.int64), last[:, None, :]], axis=1)
 
     batches = _batch_secrets(secrets, plan)  # (P, b, k)
     P, nb = batches.shape[0], batches.shape[1]
-    randomness = _device_randomness(key, (P, nb, plan.rand_size), p)
-    values = jnp.concatenate([batches.astype(jnp.int64), randomness], axis=-1)
-    S_T = jnp.asarray(plan.share_matrix.T)  # (k+t, n)
+    randomness = draw(key, (P, nb, plan.rand_size), p)
     if use_limbs:
-        from .limbmatmul import limb_modmatmul
+        from .limbmatmul import limb_modmatmul_const
 
+        # keep the big tensor in native int32 lanes when the field fits
+        dt = jnp.int32 if p <= (1 << 31) else jnp.int64
+        values = jnp.concatenate(
+            [batches.astype(dt), randomness.astype(dt)], axis=-1
+        )
         flat = values.reshape(-1, values.shape[-1])
-        shares = limb_modmatmul(flat, S_T, p).reshape(P, nb, -1)
+        shares = limb_modmatmul_const(flat, plan.share_matrix.T, p).reshape(P, nb, -1)
     else:
+        values = jnp.concatenate(
+            [batches.astype(jnp.int64), randomness.astype(jnp.int64)], axis=-1
+        )
+        S_T = jnp.asarray(plan.share_matrix.T)  # (k+t, n)
         if p >= (1 << 31):
             raise ValueError(
                 "int64 share products overflow for p >= 2^31; use the limb "
@@ -156,7 +172,7 @@ def share_participants(secrets, key, plan: AggregationPlan, use_limbs: bool = Fa
     return jnp.swapaxes(shares, 1, 2)  # (P, n, B)
 
 
-def share_combine_limb(secrets, key, plan: AggregationPlan):
+def share_combine_limb(secrets, key, plan: AggregationPlan, draw=None):
     """Fused share + clerk-combine in limb space: (C, d) -> (W, b, n) int64.
 
     The hot loop stays division-free: int8 MXU matmuls produce weight-grouped
@@ -168,23 +184,26 @@ def share_combine_limb(secrets, key, plan: AggregationPlan):
     multiply/divide never touches the (participants x dim) tensor.
     """
     jnp = _jnp()
-    from .limbmatmul import limb_partials
+    from .limbmatmul import fold_const_limbs, limb_partials_const
 
+    if draw is None:
+        draw = _device_randomness
     p = plan.modulus
     batches = _batch_secrets(secrets, plan)  # (C, b, k)
     C, nb = batches.shape[0], batches.shape[1]
-    randomness = _device_randomness(key, (C, nb, plan.rand_size), p)
-    values = jnp.concatenate([batches.astype(jnp.int64), randomness], axis=-1)
-    S_T = jnp.asarray(plan.share_matrix.T)  # (k+t, n)
-    partials = limb_partials(values.reshape(C * nb, -1), S_T, p)  # (W, C*nb, n)
-    W = partials.shape[0]
+    randomness = draw(key, (C, nb, plan.rand_size), p)
+    # keep the big tensor in native int32 lanes when the field fits
+    dt = jnp.int32 if p <= (1 << 31) else jnp.int64
+    values = jnp.concatenate([batches.astype(dt), randomness.astype(dt)], axis=-1)
+    stacks = fold_const_limbs(plan.share_matrix.T, p)  # (L, L*(k+t), n)
+    partials = limb_partials_const(
+        values.reshape(C * nb, -1), stacks, p
+    )  # (W=L, C*nb, n)
+    W, LK = stacks.shape[0], stacks.shape[1]
     per_part = partials.reshape(W, C, nb, -1)
     # participant-axis reduction: stay in int32 when the bound allows
-    # (partial elements <= K * 127^2 * L), halving the reduction cost
-    from .limbmatmul import limb_count
-
-    K = values.shape[-1]
-    if C * K * 127 * 127 * limb_count(p) < 2**31:
+    # (partial elements <= L*K * 127^2), halving the reduction cost
+    if C * LK * 127 * 127 < 2**31:
         return jnp.sum(per_part, axis=1).astype(jnp.int64)  # (W, b, n)
     return jnp.sum(per_part.astype(jnp.int64), axis=1)  # (W, b, n)
 

@@ -107,6 +107,108 @@ def limb_modmatmul(A, B, p: int):
     return limb_recombine(limb_partials(A, B, p), p)
 
 
+def fold_const_limbs(B_host, p: int):
+    """Weight-folded limb decomposition of a *constant* matrix B (K, N).
+
+    For a host-known B (the share matrix: ops/shamir.py precomputes it once
+    per scheme), the cross-limb weight structure can be folded into B ahead
+    of time:  ``A @ B = Σ_i a_i·128^i @ B = Σ_i a_i @ (128^i·B mod p)``.
+    Decomposing each ``D_i = 128^i·B mod p`` back into base-128 limbs
+    ``d_{i,m}`` and stacking the ``i`` axis onto the contraction gives
+
+        ``A @ B ≡ Σ_m 128^m · (A_limbs @ stacks[m])  (mod p)``
+
+    with ``A_limbs = [a_0 | … | a_{L-1}]`` of shape (M, L·K). Compared to
+    the generic ``limb_partials`` this is L matmuls instead of L² and L
+    weight groups instead of 2L−1 — and each partial is bounded by
+    ``L·K·127²``, small enough that the whole recombine needs ONE int64
+    ``rem`` at the very end (no per-weight division on the big tensor).
+
+    Returns int8 ``(L, L·K, N)`` stacks. Exact for any p (host python-int
+    arithmetic); device recombine still requires p < 2^31.
+    """
+    import numpy as np
+
+    L = limb_count(p)
+    B_obj = np.asarray(B_host, dtype=object)
+    K, N = B_obj.shape
+    stacks = np.empty((L, L * K, N), dtype=np.int8)
+    for i in range(L):
+        D_i = (pow(128, i, p) * B_obj) % p
+        for m in range(L):
+            stacks[m, i * K : (i + 1) * K] = ((D_i >> (7 * m)) & 0x7F).astype(
+                np.int8
+            )
+    return stacks
+
+
+def limb_partials_const(A, stacks, p: int):
+    """Weight-grouped partials of ``A @ B mod p`` from ``fold_const_limbs(B)``.
+
+    ``A`` (M, K) canonical; returns int32 ``(L, M, N)`` such that the true
+    product is ``Σ_m partials[m]·128^m (mod p)`` — drop-in for
+    ``limb_partials`` (just a shorter weight axis) wherever B is constant,
+    e.g. the fused share+combine hot loop. Each partial ≤ L·K·127².
+    """
+    ensure_x64()
+    import jax.numpy as jnp
+    from jax import lax
+
+    L, LK, N = stacks.shape
+    K = LK // L
+    if A.shape[-1] != K:
+        raise ValueError(f"A contraction {A.shape[-1]} != stacks K {K}")
+    if LK * 127 * 127 >= (1 << 31):
+        raise ValueError(f"contraction {LK} overflows int32 accumulator")
+
+    x = A.astype(jnp.int32) if p <= (1 << 31) else A.astype(jnp.int64)
+    seven = x.dtype.type(0x7F)
+    a_limbs = jnp.concatenate(
+        [((x >> x.dtype.type(7 * i)) & seven).astype(jnp.int8) for i in range(L)],
+        axis=-1,
+    )  # (M, L*K)
+    partials = [
+        lax.dot_general(
+            a_limbs,
+            jnp.asarray(stacks[m]),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        for m in range(L)
+    ]
+    return jnp.stack(partials)  # (L, M, N) int32
+
+
+def limb_modmatmul_const(A, B_host, p: int):
+    """(M, K) @ const (K, N) mod p with one final division.
+
+    The single-rem recombine is exact because every partial is bounded by
+    ``L·K·127²`` (not 2^31): the weighted int64 accumulator stays below
+    ``L · L·K·127² · (p−1)``, checked against 2^63 at trace time.
+    """
+    ensure_x64()
+    import jax.numpy as jnp
+    from jax import lax
+
+    if p >= (1 << 31):
+        raise ValueError(
+            "device recombine needs p < 2^31; use limb_partials_const + "
+            "reduce + limb_recombine_host"
+        )
+    stacks = fold_const_limbs(B_host, p)
+    L, LK, _ = stacks.shape
+    if L * (LK * 127 * 127) * (p - 1) >= (1 << 63):
+        # fall back to per-weight reduction (never hit at SDA shapes)
+        return limb_recombine(limb_partials_const(A, stacks, p), p)
+    partials = limb_partials_const(A, stacks, p)
+    weights = jnp.asarray([pow(128, m, p) for m in range(L)], dtype=jnp.int64)
+    acc = jnp.sum(
+        partials.astype(jnp.int64) * weights.reshape((L,) + (1,) * (partials.ndim - 1)),
+        axis=0,
+    )
+    return lax.rem(acc, jnp.int64(p))
+
+
 def limb_recombine_host(partials, p: int):
     """Exact host recombine for wide moduli (p >= 2^31): the weighted sum
     ``sum_w partials[w] * 128^w mod p`` overflows int64 on device, but the

@@ -74,6 +74,40 @@ def test_limb_modmatmul_exact(jax_mods):
     np.testing.assert_array_equal(got, want.astype(np.int64))
 
 
+def test_limb_modmatmul_const_exact(jax_mods):
+    """Const-folded limb matmul (weight-folded B, single final rem) is
+    exact at worst-case width, including against the generic limb path."""
+    import jax.numpy as jnp
+
+    from sda_tpu.parallel.limbmatmul import (
+        fold_const_limbs,
+        limb_modmatmul,
+        limb_modmatmul_const,
+        limb_partials_const,
+        limb_recombine_host,
+    )
+
+    p = (1 << 31) - 1
+    rng = np.random.default_rng(12)
+    A = rng.integers(0, p, size=(33, 20), dtype=np.int64)
+    B = rng.integers(0, p, size=(20, 9), dtype=np.int64)
+    want = ((A.astype(object) @ B.astype(object)) % p).astype(np.int64)
+    got = np.asarray(limb_modmatmul_const(jnp.asarray(A), B, p))
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(
+        got, np.asarray(limb_modmatmul(jnp.asarray(A), jnp.asarray(B), p))
+    )
+    # wide modulus: partials + host recombine stays exact
+    pw = (1 << 61) - 1  # Mersenne prime
+    Aw = rng.integers(0, pw, size=(9, 6), dtype=np.int64)
+    Bw = rng.integers(0, pw, size=(6, 4), dtype=np.int64)
+    stacks = fold_const_limbs(Bw, pw)
+    partials = np.asarray(limb_partials_const(jnp.asarray(Aw), stacks, pw))
+    got_w = limb_recombine_host(partials, pw)
+    want_w = ((Aw.astype(object) @ Bw.astype(object)) % pw).astype(np.int64)
+    np.testing.assert_array_equal(got_w, want_w)
+
+
 def test_limb_path_matches_int64_path(jax_mods):
     import jax.numpy as jnp
     from jax import random
