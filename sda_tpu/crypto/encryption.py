@@ -64,6 +64,8 @@ class SodiumDecryptor(ShareDecryptor):
         self.sk = keypair.dk.data
 
     def decrypt(self, encryption):
+        if encryption.variant != "Sodium":
+            raise ValueError(f"sodium decryptor got a {encryption.variant} ciphertext")
         raw = sodium.seal_open(bytes(encryption.inner), self.pk, self.sk)
         return native.varint_decode(raw)
 
@@ -81,14 +83,47 @@ def generate_encryption_keypair() -> EncryptionKeypair:
     return EncryptionKeypair(ek=EncryptionKey(B32(pk)), dk=DecryptionKey(B32(sk)))
 
 
+# -- Paillier wire format ----------------------------------------------------
+# One Encryption (variant "Paillier"): 4-byte big-endian value count, then
+# fixed-width big-endian ciphertext blocks (2 * key bytes each, c < n^2).
+# The count header exists because block packing pads: padding must not
+# change the vector length on the way back through decrypt. These three
+# helpers are the single definition of that format — encryptor, decryptor,
+# and the server-side combine all go through them.
+
+
+def _paillier_block_bytes(n: int) -> int:
+    return 2 * ((n.bit_length() + 7) // 8)
+
+
+def _paillier_encode(blocks, count: int, block_bytes: int) -> "Encryption":
+    raw = count.to_bytes(4, "big") + b"".join(
+        c.to_bytes(block_bytes, "big") for c in blocks
+    )
+    return Encryption(Binary(raw), variant="Paillier")
+
+
+def _paillier_decode(encryption, block_bytes: int):
+    """-> (count, blocks). Validates the variant tag and block alignment."""
+    if encryption.variant != "Paillier":
+        raise ValueError(f"expected a Paillier ciphertext, got {encryption.variant}")
+    raw = bytes(encryption.inner)
+    count, raw = int.from_bytes(raw[:4], "big"), raw[4:]
+    if len(raw) % block_bytes:
+        raise ValueError("ciphertext length not a multiple of the block width")
+    blocks = [
+        int.from_bytes(raw[i : i + block_bytes], "big")
+        for i in range(0, len(raw), block_bytes)
+    ]
+    return count, blocks
+
+
 class PaillierEncryptor(ShareEncryptor):
     """Packed-Paillier encryption of nonnegative bounded value vectors.
 
-    Wire format of one Encryption: fixed-width big-endian ciphertext
-    blocks (2 * key bytes each, since c < n^2), concatenated — the block
-    width is derivable from the public key on both sides. Values must be
-    canonical nonnegative residues below 2^max_value_bitsize (the mask
-    path guarantees this; shares can be negative and stay on sodium).
+    Values must be canonical nonnegative residues below
+    2^max_value_bitsize (the mask path guarantees this; shares can be
+    negative and stay on sodium).
     """
 
     def __init__(self, ek: PaillierEncryptionKey, scheme: PackedPaillierEncryptionScheme):
@@ -100,19 +135,14 @@ class PaillierEncryptor(ShareEncryptor):
         self.packing = paillier.Packing(
             scheme.component_count, scheme.component_bitsize, scheme.max_value_bitsize
         )
-        self.block_bytes = 2 * ((ek.n.bit_length() + 7) // 8)
+        self.block_bytes = _paillier_block_bytes(ek.n)
 
     def encrypt(self, shares):
         values = [int(v) for v in np.asarray(shares, dtype=np.int64)]
         if any(v < 0 for v in values):
             raise ValueError("Paillier packing requires nonnegative values")
         blocks = paillier.encrypt_vector(self.pk, self.packing, values)
-        # 4-byte value-count header: block padding must not change the
-        # vector length on the way back through decrypt
-        raw = len(values).to_bytes(4, "big") + b"".join(
-            c.to_bytes(self.block_bytes, "big") for c in blocks
-        )
-        return Encryption(Binary(raw))
+        return _paillier_encode(blocks, len(values), self.block_bytes)
 
 
 class PaillierDecryptor(ShareDecryptor):
@@ -121,17 +151,10 @@ class PaillierDecryptor(ShareDecryptor):
         self.packing = paillier.Packing(
             scheme.component_count, scheme.component_bitsize, scheme.max_value_bitsize
         )
-        self.block_bytes = 2 * ((keypair.ek.n.bit_length() + 7) // 8)
+        self.block_bytes = _paillier_block_bytes(keypair.ek.n)
 
     def decrypt(self, encryption):
-        raw = bytes(encryption.inner)
-        count, raw = int.from_bytes(raw[:4], "big"), raw[4:]
-        if len(raw) % self.block_bytes:
-            raise ValueError("ciphertext length not a multiple of the block width")
-        blocks = [
-            int.from_bytes(raw[i : i + self.block_bytes], "big")
-            for i in range(0, len(raw), self.block_bytes)
-        ]
+        count, blocks = _paillier_decode(encryption, self.block_bytes)
         values = paillier.decrypt_vector(self.sk, self.packing, blocks, count)
         # component_bitsize <= 62 (scheme invariant): sums fit int64
         return np.asarray(values, dtype=np.int64)
@@ -146,31 +169,18 @@ def combine_encryptions(ek, scheme, encryptions: list) -> "Encryption":
     if not isinstance(ek, PaillierEncryptionKey):
         raise TypeError("combine requires a Paillier public key")
     pk = paillier.PaillierPublicKey(ek.n)
-    block_bytes = 2 * ((ek.n.bit_length() + 7) // 8)
-
-    def blocks_of(e):
-        raw = bytes(e.inner)
-        count, raw = int.from_bytes(raw[:4], "big"), raw[4:]
-        if len(raw) % block_bytes:
-            raise ValueError("ciphertext length not a multiple of the block width")
-        return count, [
-            int.from_bytes(raw[i : i + block_bytes], "big")
-            for i in range(0, len(raw), block_bytes)
-        ]
+    block_bytes = _paillier_block_bytes(ek.n)
 
     combined, count0 = None, None
     for e in encryptions:
-        count, b = blocks_of(e)
+        count, b = _paillier_decode(e, block_bytes)
         if combined is None:
             combined, count0 = b, count
         else:
             if count != count0:
                 raise ValueError("mismatched vector lengths in combine")
             combined = paillier.add_vectors(pk, combined, b)
-    raw = count0.to_bytes(4, "big") + b"".join(
-        c.to_bytes(block_bytes, "big") for c in combined
-    )
-    return Encryption(Binary(raw))
+    return _paillier_encode(combined, count0, block_bytes)
 
 
 def generate_paillier_keypair(modulus_bits: int = 2048):

@@ -23,8 +23,16 @@ import random
 _SMALL_PRIMES = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37]
 
 
-def is_prime(n: int) -> bool:
-    """Deterministic Miller-Rabin for n < 3.3e24 (covers 64-bit)."""
+#: the fixed 12-base Miller-Rabin set is a proven deterministic test
+#: only below this bound (first 12-base strong pseudoprime > 3.3e24)
+_DETERMINISTIC_MR_BOUND = 3317044064679887385961981
+
+
+def is_prime(n: int, rng=None) -> bool:
+    """Miller-Rabin: deterministic for n < 3.3e24 (covers the 64-bit field
+    moduli); above that, 40 additional *random*-base rounds (error
+    < 4^-40, bases unpredictable to an adversary) — required for Paillier
+    keygen, whose candidates are 1024-bit."""
     if n < 2:
         return False
     for p in _SMALL_PRIMES:
@@ -35,17 +43,27 @@ def is_prime(n: int) -> bool:
     while d % 2 == 0:
         d //= 2
         r += 1
-    for a in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+
+    def strong_probable_prime(a: int) -> bool:
         x = pow(a, d, n)
         if x in (1, n - 1):
-            continue
+            return True
         for _ in range(r - 1):
             x = x * x % n
             if x == n - 1:
-                break
+                return True
+        return False
+
+    bases = list(_SMALL_PRIMES)
+    if n >= _DETERMINISTIC_MR_BOUND:
+        if rng is None:
+            import secrets as _secrets
+
+            draw = lambda: _secrets.randbelow(n - 3) + 2
         else:
-            return False
-    return True
+            draw = lambda: rng.randrange(2, n - 1)
+        bases += [draw() for _ in range(40)]
+    return all(strong_probable_prime(a) for a in bases)
 
 
 def _factorize(n: int) -> dict:

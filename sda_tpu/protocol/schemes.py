@@ -74,9 +74,38 @@ class _SodiumNewtype:
 
 
 class Encryption(_SodiumNewtype):
-    """A ciphertext: sodium sealed box (Curve25519/XSalsa20/Poly1305)."""
+    """A ciphertext. Reference enum has one variant, ``Sodium`` (sealed
+    box, crypto.rs:8-14); ``Paillier`` is our wire-compatible extension
+    carrying packed-Paillier blocks, tagged so external consumers never
+    misread one payload kind as the other."""
 
     INNER = Binary
+    VARIANTS = ("Sodium", "Paillier")
+    __slots__ = ("variant",)
+
+    def __init__(self, inner, variant: str = "Sodium"):
+        super().__init__(inner)
+        if variant not in self.VARIANTS:
+            raise ValueError(f"unknown Encryption variant {variant!r}")
+        self.variant = variant
+
+    def to_json(self):
+        return _tagged(self.variant, self.inner.to_json())
+
+    @classmethod
+    def from_json(cls, obj):
+        tag, payload = _untag(obj, cls.VARIANTS)
+        return cls(Binary.from_json(payload), variant=tag)
+
+    def __eq__(self, other) -> bool:
+        return (
+            type(other) is type(self)
+            and other.inner == self.inner
+            and other.variant == self.variant
+        )
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.variant, self.inner))
 
 
 class EncryptionKey(_SodiumNewtype):

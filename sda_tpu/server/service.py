@@ -133,23 +133,34 @@ class SdaServer:
     def delete_aggregation(self, aggregation_id) -> None:
         self.aggregation_store.delete_aggregation(aggregation_id)
 
+    def _sodium_key_of(self, key_id, owner):
+        """The registered sodium box key ``key_id`` signed by ``owner``, or
+        None. The single definition of "usable clerk key": clerk transport
+        is sodium sealed boxes (a Paillier key would crash participants at
+        share-sealing time), and participants verify signer == clerk
+        client-side (participate.py), so a key signed by anyone else
+        dead-ends the aggregation just the same."""
+        from ..protocol import EncryptionKey
+
+        signed = self.agents_store.get_encryption_key(key_id)
+        if (
+            signed is not None
+            and signed.signer == owner
+            and isinstance(signed.body.body, EncryptionKey)
+        ):
+            return signed
+        return None
+
     def suggest_committee(self, aggregation_id):
         if self.aggregation_store.get_aggregation(aggregation_id) is None:
             raise ServerError("aggregation not found")
-        from ..protocol import EncryptionKey
-
-        # clerk transport is sodium sealed boxes; a candidate whose only
-        # published key is e.g. a Paillier recipient key cannot receive
-        # shares — offer only sodium-capable keys (and drop keyless agents)
+        # offer only keys a participant could actually seal shares to
+        # (and drop agents left with none)
         candidates = []
         for cand in self.agents_store.suggest_committee():
-            sodium_keys = []
-            for key_id in cand.keys:
-                signed = self.agents_store.get_encryption_key(key_id)
-                if signed is not None and isinstance(signed.body.body, EncryptionKey):
-                    sodium_keys.append(key_id)
-            if sodium_keys:
-                candidates.append(type(cand)(id=cand.id, keys=sodium_keys))
+            usable = [k for k in cand.keys if self._sodium_key_of(k, cand.id)]
+            if usable:
+                candidates.append(type(cand)(id=cand.id, keys=usable))
         return candidates
 
     def create_committee(self, committee) -> None:
@@ -167,6 +178,15 @@ class SdaServer:
         clerk_ids = [c for (c, _) in committee.clerks_and_keys]
         if len(set(clerk_ids)) != len(clerk_ids):
             raise InvalidRequestError("committee contains duplicate clerks")
+        # suggest_committee already filters to usable keys, but the
+        # invariant must hold for committees built by any client, so
+        # enforce it at the accept point too (see _sodium_key_of).
+        for clerk_id, key_id in committee.clerks_and_keys:
+            if self._sodium_key_of(key_id, clerk_id) is None:
+                raise InvalidRequestError(
+                    f"committee key {key_id} of clerk {clerk_id} is not a "
+                    "registered sodium box key signed by that clerk"
+                )
         self.aggregation_store.create_committee(committee)
 
     def create_participation(self, participation) -> None:

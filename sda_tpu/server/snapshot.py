@@ -110,7 +110,11 @@ def _maybe_combine_masks(server, aggregation, recipient_encryptions):
         return recipient_encryptions
     if len(recipient_encryptions) < 2:
         return recipient_encryptions
-    capacity = 1 << (scheme.component_bitsize - scheme.max_value_bitsize)
+    from ..ops.paillier import Packing
+
+    capacity = Packing(
+        scheme.component_count, scheme.component_bitsize, scheme.max_value_bitsize
+    ).additions_capacity
     if len(recipient_encryptions) > capacity:
         log.warning(
             "snapshot: %d participations exceed Paillier addition capacity %d; "
@@ -125,6 +129,20 @@ def _maybe_combine_masks(server, aggregation, recipient_encryptions):
         return recipient_encryptions
     from ..crypto.encryption import combine_encryptions
 
-    with get_metrics().phase("snapshot.paillier_combine"):
-        combined = combine_encryptions(signed.body.body, scheme, recipient_encryptions)
+    try:
+        with get_metrics().phase("snapshot.paillier_combine"):
+            combined = combine_encryptions(
+                signed.body.body, scheme, recipient_encryptions
+            )
+    except Exception:
+        # one malformed participant upload must not wedge the snapshot
+        # forever (retries would re-read the same stored participations):
+        # the uncombined list is always a correct fallback — the recipient
+        # decrypts and combines client-side.
+        log.warning(
+            "snapshot: homomorphic mask combine failed; leaving masks "
+            "uncombined",
+            exc_info=True,
+        )
+        return recipient_encryptions
     return [combined]

@@ -201,3 +201,120 @@ def test_keystore_files_are_private(tmp_path):
     for f in os.listdir(key_dir):
         mode = stat.S_IMODE(os.stat(key_dir / f).st_mode)
         assert mode == 0o600, f"{f} has mode {oct(mode)}"
+
+
+def test_committee_rejects_non_sodium_clerk_key(tmp_path):
+    """Clerk transport is sodium sealed boxes: a committee pointing a clerk
+    at a Paillier key would crash every participant at share-sealing time,
+    so create_committee must reject it — the suggest_committee filter alone
+    doesn't bind committees built by arbitrary clients."""
+    with with_server() as ctx:
+        alice, alice_key = new_full_agent(ctx.service)
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="x",
+            vector_dimension=4,
+            modulus=13,
+            recipient=alice.id,
+            recipient_key=alice_key.body.id,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=13),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        ctx.service.create_aggregation(alice, agg)
+
+        paillier_client = new_client(tmp_path / "pc", ctx.service)
+        paillier_client.upload_agent()
+        pkey = paillier_client.crypto.new_paillier_encryption_key(modulus_bits=512)
+        paillier_client.upload_encryption_key(pkey)
+
+        committee = Committee(
+            aggregation=agg.id,
+            clerks_and_keys=[
+                (alice.id, alice_key.body.id),
+                (paillier_client.agent.id, pkey),
+            ],
+        )
+        with pytest.raises(InvalidRequestError, match="sodium"):
+            ctx.service.create_committee(alice, committee)
+        # unknown key ids are rejected too
+        from sda_tpu.protocol import EncryptionKeyId
+
+        committee = Committee(
+            aggregation=agg.id,
+            clerks_and_keys=[
+                (alice.id, alice_key.body.id),
+                (paillier_client.agent.id, EncryptionKeyId.random()),
+            ],
+        )
+        with pytest.raises(InvalidRequestError, match="sodium"):
+            ctx.service.create_committee(alice, committee)
+        # and so is binding clerk X to a key signed by agent Y: participants
+        # verify signer == clerk client-side, so the aggregation would
+        # dead-end at share-sealing with zero participations
+        bob, bob_key = new_full_agent(ctx.service)
+        committee = Committee(
+            aggregation=agg.id,
+            clerks_and_keys=[
+                (alice.id, alice_key.body.id),
+                (bob.id, alice_key.body.id),
+            ],
+        )
+        with pytest.raises(InvalidRequestError, match="signed by"):
+            ctx.service.create_committee(alice, committee)
+
+
+def test_snapshot_combine_falls_back_on_malformed_ciphertext(tmp_path):
+    """One malformed participant upload must not wedge the snapshot: the
+    homomorphic mask combine falls back to the uncombined list (always
+    correct — the recipient combines client-side after decrypting)."""
+    from sda_tpu.protocol import Binary, Encryption, PackedPaillierEncryptionScheme
+    from sda_tpu.server.snapshot import _maybe_combine_masks
+
+    with with_server() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_paillier_encryption_key(modulus_bits=512)
+        recipient.upload_encryption_key(rkey)
+        scheme = PackedPaillierEncryptionScheme(10, 40, 32, 512)
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="x",
+            vector_dimension=4,
+            modulus=433,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=433),
+            recipient_encryption_scheme=scheme,
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+
+        inner = ctx.server.server  # SdaServer behind the ACL wrapper
+        signed = inner.agents_store.get_encryption_key(rkey)
+        from sda_tpu.crypto.encryption import PaillierEncryptor
+
+        enc = PaillierEncryptor(signed.body.body, scheme)
+        good = [enc.encrypt([1, 2, 3, 4]), enc.encrypt([5, 6, 7, 8])]
+        bad = Encryption(Binary(b"\x00\x00\x00\x04garbage"), variant="Paillier")
+
+        # healthy cohort combines into one blob
+        assert len(_maybe_combine_masks(inner, agg, list(good))) == 1
+        # malformed blob in the cohort: falls back, never raises
+        out = _maybe_combine_masks(inner, agg, good + [bad])
+        assert out == good + [bad]
+
+
+def test_miller_rabin_beyond_deterministic_range():
+    """Above the 12-base deterministic bound, is_prime adds random-base
+    rounds — fixed public bases alone are not a primality proof there
+    (Paillier keygen feeds 1024-bit candidates)."""
+    from sda_tpu.ops.params import _DETERMINISTIC_MR_BOUND, is_prime
+
+    m89 = (1 << 89) - 1  # Mersenne prime above the deterministic bound
+    assert m89 > _DETERMINISTIC_MR_BOUND
+    assert is_prime(m89)
+    m61 = (1 << 61) - 1
+    assert not is_prime(m61 * m61)
+    assert not is_prime(m89 * m61)

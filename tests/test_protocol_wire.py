@@ -128,6 +128,21 @@ def test_encryption_newtype_tagging():
     assert Encryption.from_json(e.to_json()) == e
 
 
+def test_encryption_paillier_variant_tagging():
+    """Paillier ciphertexts carry their own wire tag — an external consumer
+    distinguishing enum variants must never misread one payload kind as a
+    sodium sealed box (or vice versa)."""
+    import pytest
+
+    e = Encryption(Binary(b"\x01\x02"), variant="Paillier")
+    assert e.to_json() == {"Paillier": "AQI="}
+    assert Encryption.from_json(e.to_json()) == e
+    # variants are not interchangeable
+    assert e != Encryption(Binary(b"\x01\x02"))
+    with pytest.raises(ValueError, match="variant"):
+        Encryption(Binary(b"x"), variant="Rot13")
+
+
 def test_canonical_signing_bytes():
     # The canonical form of a labelled encryption key pins field order id,body
     # and compact separators — signature compatibility depends on this.
