@@ -99,6 +99,15 @@ class Receiving:
                 )
             )
 
+        if all(len(shares) == 0 for _, shares in indexed_shares):
+            # an empty snapshot cut (every clerk combined zero
+            # participations): the aggregate over the empty set is the
+            # zero vector — don't run the reconstructor on empty batches
+            return RecipientOutput(
+                modulus=aggregation.modulus,
+                values=np.zeros(aggregation.vector_dimension, dtype=np.int64),
+            )
+
         reconstructor = self.crypto.new_secret_reconstructor(
             aggregation.committee_sharing_scheme, aggregation.vector_dimension
         )

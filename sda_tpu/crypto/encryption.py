@@ -72,6 +72,9 @@ class SodiumDecryptor(ShareDecryptor):
     def decrypt_batch(self, encryptions) -> list:
         """Open many sealed boxes in one native batch call (the clerk-side
         per-participant loop, clerk.rs:79-82)."""
+        for e in encryptions:
+            if e.variant != "Sodium":
+                raise ValueError(f"sodium decryptor got a {e.variant} ciphertext")
         raws = native.open_batch(
             [bytes(e.inner) for e in encryptions], self.pk, self.sk
         )
@@ -181,6 +184,29 @@ def combine_encryptions(ek, scheme, encryptions: list) -> "Encryption":
                 raise ValueError("mismatched vector lengths in combine")
             combined = paillier.add_vectors(pk, combined, b)
     return _paillier_encode(combined, count0, block_bytes)
+
+
+def paillier_ciphertext_well_formed(
+    encryption, ek: PaillierEncryptionKey, scheme, expected_values: int | None
+) -> bool:
+    """Cheap *public* well-formedness check of one Paillier Encryption:
+    variant tag, count header, block alignment, block count consistent with
+    the packing, and every block in (0, n²). Lets the server reject
+    malformed uploads at the participation door — where a garbage blob
+    would otherwise surface only at snapshot-combine or recipient-decrypt
+    time, after the participant's shares are already in the aggregate."""
+    try:
+        block_bytes = _paillier_block_bytes(ek.n)
+        count, blocks = _paillier_decode(encryption, block_bytes)
+    except ValueError:
+        return False
+    if expected_values is not None and count != expected_values:
+        return False
+    expected_blocks = -(-count // scheme.component_count) if count else 0
+    if len(blocks) != expected_blocks:
+        return False
+    n_sq = ek.n * ek.n
+    return all(0 < b < n_sq for b in blocks)
 
 
 def generate_paillier_keypair(modulus_bits: int = 2048):

@@ -100,6 +100,11 @@ class Combiner(ShareCombiner):
         self.modulus = modulus
 
     def combine(self, share_vectors):
+        if not len(share_vectors):
+            # empty snapshot cut: the reference yields the empty vector
+            # (combiner.rs:17 — `map_or(0, Vec::len)` defaults the
+            # dimension to 0 when there are no shares)
+            return np.empty(0, dtype=np.int64)
         stack = np.stack([np.asarray(v, dtype=np.int64) for v in share_vectors])
         if self.modulus < MAX_SAFE_MODULUS and len(stack) < (1 << 32):
             return rust_rem_np(stack.sum(axis=0), self.modulus)

@@ -204,7 +204,44 @@ class SdaServer:
             raise InvalidRequestError(
                 "participation clerk encryptions do not match the committee"
             )
+        # clerk transport is sodium; a mis-tagged ciphertext would only
+        # surface as an opaque clerk-side decrypt failure later
+        if any(e.variant != "Sodium" for (_, e) in participation.clerk_encryptions):
+            raise InvalidRequestError("clerk encryptions must be sodium sealed boxes")
+        self._validate_recipient_encryption(participation)
         self.aggregation_store.create_participation(participation)
+
+    def _validate_recipient_encryption(self, participation) -> None:
+        """Shape-check the recipient (mask) ciphertext at the door. For
+        Paillier the wire format is public, so a garbage blob — which would
+        otherwise surface only at snapshot-combine or recipient-decrypt
+        time, after the participant's shares are in the aggregate — is
+        rejected here. Sodium sealed boxes are opaque; only the variant tag
+        can be checked."""
+        from ..protocol import PackedPaillierEncryptionScheme
+
+        enc = participation.recipient_encryption
+        if enc is None:
+            return
+        agg = self.aggregation_store.get_aggregation(participation.aggregation)
+        if agg is None:
+            return  # caller's store write will surface the missing aggregation
+        scheme = agg.recipient_encryption_scheme
+        if not isinstance(scheme, PackedPaillierEncryptionScheme):
+            if enc.variant != "Sodium":
+                raise InvalidRequestError(
+                    "recipient encryption must be a sodium sealed box"
+                )
+            return
+        from ..crypto.encryption import paillier_ciphertext_well_formed
+
+        signed = self.agents_store.get_encryption_key(agg.recipient_key)
+        if signed is None:
+            return  # can't check without the key; combine falls back safely
+        if not paillier_ciphertext_well_formed(
+            enc, signed.body.body, scheme, agg.vector_dimension
+        ):
+            raise InvalidRequestError("malformed Paillier recipient encryption")
 
     def get_aggregation_status(self, aggregation_id) -> Optional[AggregationStatus]:
         agg = self.aggregation_store.get_aggregation(aggregation_id)

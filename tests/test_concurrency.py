@@ -1,0 +1,220 @@
+"""Concurrency/race tests the reference never had (SURVEY.md §5: "Race
+detection: none"). The server is a shared multi-tenant surface: stores must
+hold their invariants under concurrent agents, clerks, and REST requests.
+
+These run against the in-process service by default and the full REST stack
+/ file / sqlite backends via the SDA_TEST_HTTP / SDA_TEST_STORE env matrix
+(scripts/test-matrix.sh), mirroring how the fixture seam works everywhere
+else in the suite.
+"""
+
+import threading
+
+import numpy as np
+
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    NoMasking,
+    SodiumEncryptionScheme,
+)
+
+from sda_fixtures import new_client, new_full_agent, with_server, with_service
+
+
+def _run_threads(fns):
+    """Run callables concurrently; re-raise the first exception."""
+    errors = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def _additive_agg(recipient, rkey, dim=4, modulus=433, share_count=3):
+    return Aggregation(
+        id=AggregationId.random(),
+        title="conc",
+        vector_dimension=dim,
+        modulus=modulus,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(
+            share_count=share_count, modulus=modulus
+        ),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+def test_concurrent_participations_all_counted(tmp_path):
+    """N participants uploading simultaneously: every participation lands,
+    the snapshot routes all of them, and the aggregate is exact."""
+    n_participants = 12
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _additive_agg(recipient, rkey)
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+
+        participants = []
+        for i in range(n_participants):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            participants.append(p)
+
+        _run_threads(
+            [
+                (lambda p=p, i=i: p.participate([i + 1, 1, 2, 3], agg.id))
+                for i, p in enumerate(participants)
+            ]
+        )
+
+        recipient.end_aggregation(agg.id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        # out[1] == n_participants proves every racing upload made the cut
+        want = np.array(
+            [
+                sum(range(1, n_participants + 1)) % 433,
+                n_participants % 433,
+                (2 * n_participants) % 433,
+                (3 * n_participants) % 433,
+            ]
+        )
+        np.testing.assert_array_equal(out, want)
+
+
+def test_concurrent_clerks_and_double_polling(tmp_path):
+    """All committee members drain their queues in parallel threads, two
+    threads per member (the same clerk polling its queue twice
+    concurrently): results stay exactly-once per job and the aggregate is
+    exact — delete-after-result queue semantics under contention."""
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(4)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _additive_agg(recipient, rkey, share_count=3)
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        for i in range(5):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            p.participate([1, 2, 3, 4], agg.id)
+        recipient.end_aggregation(agg.id)
+
+        workers = [recipient] + clerks
+        _run_threads([(lambda w=w: w.run_chores(-1)) for w in workers for _ in range(2)])
+
+        status = ctx.service.get_aggregation_status(recipient.agent, agg.id)
+        assert status.snapshots[0].number_of_clerking_results == 3
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [5, 10, 15, 20])
+
+
+def test_rest_parallel_agent_registration(tmp_path):
+    """The REST binding is a threading server: concurrent create/get over
+    live sockets must not corrupt the agent store or the TOFU token table."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    server = new_mem_server()
+    n_agents = 12
+    with serve_background(server) as base_url:
+        clients = []
+        for i in range(n_agents):
+            service = SdaHttpClient(base_url, TokenStore(tmp_path / f"t{i}"))
+            clients.append(new_client(tmp_path / f"a{i}", service))
+
+        _run_threads(
+            [
+                (
+                    lambda c=c: (
+                        c.upload_agent(),
+                        c.upload_encryption_key(c.new_encryption_key()),
+                    )
+                )
+                for c in clients
+            ]
+        )
+
+        # every agent registered, its key resolvable, its token bound
+        probe = clients[0]
+        for c in clients:
+            got = probe.service.get_agent(probe.agent, c.agent.id)
+            assert got == c.agent
+
+
+def test_participations_racing_snapshot(tmp_path):
+    """Participations racing the snapshot cut: the snapshot freezes a
+    consistent subset (every member fully stored, count matches the
+    transpose), and late arrivals are cleanly excluded, not corrupted."""
+    with with_server() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _additive_agg(recipient, rkey)
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+
+        participants = []
+        for i in range(10):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            participants.append(p)
+
+        barrier = threading.Barrier(11)
+
+        def participate(p):
+            barrier.wait()
+            p.participate([1, 2, 3, 4], agg.id)
+
+        def snapshot():
+            barrier.wait()
+            recipient.end_aggregation(agg.id)
+
+        _run_threads([(lambda p=p: participate(p)) for p in participants] + [snapshot])
+
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        # the cut size is whatever the race froze; consistency across all
+        # four coordinates proves every member was fully stored (a torn
+        # participation would skew one coordinate relative to the others)
+        n_in_cut = int(out[0])
+        assert 0 <= n_in_cut <= 10
+        np.testing.assert_array_equal(
+            out, (np.array([1, 2, 3, 4]) * n_in_cut) % 433
+        )

@@ -318,3 +318,72 @@ def test_miller_rabin_beyond_deterministic_range():
     m61 = (1 << 61) - 1
     assert not is_prime(m61 * m61)
     assert not is_prime(m89 * m61)
+
+
+def test_malformed_paillier_participation_rejected_at_door(tmp_path):
+    """A garbage Paillier recipient_encryption is rejected at
+    create_participation (public wire format — checkable by the untrusted
+    server), not discovered at snapshot-combine or recipient-decrypt time
+    when the participant's shares are already in the aggregate."""
+    import numpy as np
+
+    from sda_tpu.protocol import (
+        Binary,
+        Encryption,
+        FullMasking,
+        PackedPaillierEncryptionScheme,
+    )
+
+    with with_server() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_paillier_encryption_key(modulus_bits=512)
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="x",
+            vector_dimension=4,
+            modulus=433,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=FullMasking(modulus=433),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+            recipient_encryption_scheme=PackedPaillierEncryptionScheme(10, 40, 32, 512),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+
+        p = new_client(tmp_path / "p", ctx.service)
+        p.upload_agent()
+        participation = p.new_participation([1, 2, 3, 4], agg.id)
+
+        # wrong variant tag
+        original = participation.recipient_encryption
+        participation.recipient_encryption = Encryption(
+            original.inner, variant="Sodium"
+        )
+        with pytest.raises(InvalidRequestError):
+            ctx.service.create_participation(p.agent, participation)
+        # truncated / misaligned blob
+        participation.recipient_encryption = Encryption(
+            Binary(b"\x00\x00\x00\x04garbage"), variant="Paillier"
+        )
+        with pytest.raises(InvalidRequestError, match="malformed"):
+            ctx.service.create_participation(p.agent, participation)
+        # the honest upload still goes through and the round completes
+        participation.recipient_encryption = original
+        ctx.service.create_participation(p.agent, participation)
+        recipient.end_aggregation(agg.id)
+        members = {
+            c for c, _ in ctx.service.get_committee(recipient.agent, agg.id).clerks_and_keys
+        }
+        for c in [recipient] + clerks:
+            if c.agent.id in members:
+                c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [1, 2, 3, 4])
