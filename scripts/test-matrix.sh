@@ -4,7 +4,7 @@
 set -e
 cd "$(dirname "$0")/.."
 python -m pytest tests/ -q
-BINDING_SENSITIVE="tests/test_full_loop.py tests/test_server_orchestration.py tests/test_crud.py tests/test_models_federated.py tests/test_statistics.py tests/test_property_fuzz.py"
+BINDING_SENSITIVE="tests/test_full_loop.py tests/test_server_orchestration.py tests/test_crud.py tests/test_models_federated.py tests/test_statistics.py tests/test_property_fuzz.py tests/test_concurrency.py"
 SDA_TEST_STORE=file python -m pytest $BINDING_SENSITIVE -q
 SDA_TEST_STORE=sqlite python -m pytest $BINDING_SENSITIVE -q
 SDA_TEST_HTTP=1 python -m pytest $BINDING_SENSITIVE -q
