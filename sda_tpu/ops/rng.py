@@ -73,6 +73,27 @@ def uniform_bits_device(key, shape, nbits: int):
     return (u & dtype((1 << nbits) - 1)).astype(jnp.int64)
 
 
+def uniform_bits_device_pair(key, shape, nbits: int):
+    """``uniform_bits_device`` for ``32 < nbits <= 62``, returned as a
+    ``(hi, lo)`` pair of uint32 tensors with value ``hi·2³² + lo``.
+
+    The value never exists as an int64 on device: wide (61-bit) hot paths
+    consume the halves directly in native 32-bit lanes
+    (``sumfirst.value_limb_sums_chunk_pair``), skipping the emulated
+    64-bit ops that otherwise dominate. Simulation only, like the other
+    masked-bits draws."""
+    import jax.numpy as jnp
+    from jax import random
+
+    if not (32 < nbits <= 62):
+        raise ValueError(f"pair draw needs 32 < nbits <= 62, got {nbits}")
+    hi = random.bits(key, shape=shape, dtype=jnp.uint32) & jnp.uint32(
+        (1 << (nbits - 32)) - 1
+    )
+    lo = random.bits(random.fold_in(key, 1), shape=shape, dtype=jnp.uint32)
+    return hi, lo
+
+
 def uniform_bits_device_narrow(key, shape, nbits: int):
     """``uniform_bits_device`` for ``nbits <= 31``, kept int32.
 

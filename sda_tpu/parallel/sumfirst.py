@@ -48,21 +48,54 @@ def limb_count_sum(p: int) -> int:
 
 def exact_sum_narrow(x):
     """Exact axis-0 sums of nonneg int32 values < 2^31 using only native
-    int32 lane ops: split into 2^16 limbs, sum each in int32 (exact while
-    ``x.shape[0] <= MAX_NARROW_CHUNK``), widen the *reduced* result.
+    int32 lane ops — delegates to the uint32 variant (the int32→uint32
+    bit-cast is lossless for nonneg values, and logical shift equals
+    arithmetic shift there). ``(C, ...) -> (...)`` int64."""
+    import jax.numpy as jnp
 
-    ``(C, ...) -> (...)`` int64. The big (C, ...) tensor is never touched
-    by an emulated 64-bit op — the whole point on TPU lanes.
-    """
-    ensure_x64()  # the widening below must really produce int64
+    # canonical values < 2^31: int32 cast lossless, uint32 view identical
+    return exact_sum_narrow_u32(x.astype(jnp.int32).astype(jnp.uint32))
+
+
+def exact_sum_narrow_u32(x):
+    """Exact axis-0 sums of uint32 values using only native 32-bit lane
+    ops: split into 2^16 halves (logical shift on uint32), sum each in
+    int32 (exact while ``x.shape[0] <= MAX_NARROW_CHUNK``), widen only the
+    reduced result. ``(C, ...) -> (...)`` int64."""
+    ensure_x64()
     import jax.numpy as jnp
 
     if x.shape[0] > MAX_NARROW_CHUNK:
         raise ValueError(f"narrow reduction bound is {MAX_NARROW_CHUNK} rows")
-    x32 = x.astype(jnp.int32)  # canonical values < 2^31: lossless
-    lo = jnp.sum(x32 & jnp.int32(0xFFFF), axis=0, dtype=jnp.int32)
-    hi = jnp.sum(x32 >> jnp.int32(16), axis=0, dtype=jnp.int32)
+    x = x.astype(jnp.uint32)
+    lo = jnp.sum((x & jnp.uint32(0xFFFF)).astype(jnp.int32), axis=0, dtype=jnp.int32)
+    hi = jnp.sum((x >> jnp.uint32(16)).astype(jnp.int32), axis=0, dtype=jnp.int32)
     return lo.astype(jnp.int64) + (hi.astype(jnp.int64) << jnp.int64(16))
+
+
+def value_limb_sums_chunk_pair(hi, lo, key, plan: AggregationPlan, draw_pair):
+    """The wide-modulus twin of :func:`value_limb_sums_chunk` over
+    ``(hi, lo)`` uint32 pair tensors (value = hi·2³² + lo < p, p < 2⁶²).
+
+    The base-2³² limb sums the epilogue needs are exactly ``Σ lo`` and
+    ``Σ hi`` — so when values arrive as halves, no int64 tensor (emulated
+    on 32-bit TPU lanes) ever materializes: both halves reduce via the
+    16-bit-split narrow int32 sums. ``draw_pair(key, shape) -> (hi, lo)``
+    supplies the share randomness in the same representation. Returns
+    ``(2, B, K)`` int64 exact limb sums — accumulate and feed
+    ``clerk_sums_from_limb_acc`` exactly like the int64-path chunks
+    (parity-tested bit-exact against :func:`value_limb_sums_chunk`).
+    """
+    ensure_x64()
+    import jax.numpy as jnp
+
+    C = hi.shape[0]
+    batches_hi = _batch_secrets(hi, plan)  # (C, b, k) — pad/reshape, dtype-agnostic
+    batches_lo = _batch_secrets(lo, plan)
+    rand_hi, rand_lo = draw_pair(key, (C, batches_hi.shape[1], plan.rand_size))
+    cols_hi = jnp.concatenate([batches_hi, rand_hi], axis=-1)  # (C, b, K)
+    cols_lo = jnp.concatenate([batches_lo, rand_lo], axis=-1)
+    return jnp.stack([exact_sum_narrow_u32(cols_lo), exact_sum_narrow_u32(cols_hi)])
 
 
 def value_limb_sums_chunk(secrets, key, plan: AggregationPlan, draw=None):

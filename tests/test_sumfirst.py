@@ -155,3 +155,46 @@ def test_narrow_draws_match_wide(jax_mods):
     narrow = uniform_bits_device_narrow(key, (64, 5), 30)
     assert narrow.dtype == jnp.int32
     np.testing.assert_array_equal(np.asarray(narrow), np.asarray(wide))
+
+
+def test_pair_chunk_matches_int64_chunk(jax_mods):
+    """The (hi, lo) uint32 pair formulation of the wide-field hot loop —
+    no int64 tensor ever materializes on device — produces bit-identical
+    limb sums to the int64 formulation for the same values and
+    randomness."""
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel.engine import make_plan
+    from sda_tpu.parallel.sumfirst import (
+        value_limb_sums_chunk,
+        value_limb_sums_chunk_pair,
+    )
+
+    scheme = _wide_scheme()
+    p = scheme.prime_modulus
+    dim = 14  # pad path
+    plan = make_plan(scheme, dim)
+    rng = np.random.default_rng(11)
+    values = rng.integers(0, 1 << 60, size=(21, dim)).astype(np.int64)
+    randomness = rng.integers(0, 1 << 60, size=(21, plan.n_batches, plan.rand_size)).astype(np.int64)
+
+    acc_int64 = value_limb_sums_chunk(
+        jnp.asarray(values),
+        random.key(0),
+        plan,
+        draw=lambda k, s, m: jnp.asarray(randomness),
+    )
+
+    mask32 = (1 << 32) - 1
+    acc_pair = value_limb_sums_chunk_pair(
+        jnp.asarray((values >> 32).astype(np.uint32)),
+        jnp.asarray((values & mask32).astype(np.uint32)),
+        random.key(0),
+        plan,
+        draw_pair=lambda k, s: (
+            jnp.asarray((randomness >> 32).astype(np.uint32)),
+            jnp.asarray((randomness & mask32).astype(np.uint32)),
+        ),
+    )
+    np.testing.assert_array_equal(np.asarray(acc_int64), np.asarray(acc_pair))
