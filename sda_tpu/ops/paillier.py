@@ -34,6 +34,17 @@ from dataclasses import dataclass
 
 from .params import is_prime
 
+# OpenSSL BN_mod_exp/BN_mod_mul when available (~5-6x python pow at
+# 2048-bit moduli; see native/bignum.py), python otherwise — selection
+# lives in the native module so every caller picks implementations the
+# same way
+from ..native import bignum as _bignum
+
+_mod_exp = _bignum.best_mod_exp()
+_mod_mul = (
+    _bignum.mod_mul if _bignum.available() else (lambda a, b, mod: a * b % mod)
+)
+
 
 def _random_prime(bits: int) -> int:
     """Uniform-ish prime with the top two bits set (so p*q has 2*bits)."""
@@ -91,19 +102,19 @@ def encrypt(pk: PaillierPublicKey, m: int, r: int | None = None) -> int:
             r = secrets.randbelow(pk.n)
             if r and _gcd(r, pk.n) == 1:
                 break
-    return ((1 + m * pk.n) % pk.n_sq) * pow(r, pk.n, pk.n_sq) % pk.n_sq
+    return _mod_mul((1 + m * pk.n) % pk.n_sq, _mod_exp(r, pk.n, pk.n_sq), pk.n_sq)
 
 
 def add(pk: PaillierPublicKey, c1: int, c2: int) -> int:
     """E(m1) (*) E(m2) = E(m1 + m2 mod n)."""
-    return c1 * c2 % pk.n_sq
+    return _mod_mul(c1, c2, pk.n_sq)
 
 
 def decrypt(sk: PaillierPrivateKey, c: int) -> int:
     n_sq = sk.n * sk.n
     if not 0 <= c < n_sq:
         raise ValueError("ciphertext out of range")
-    u = pow(c, sk.lam, n_sq)
+    u = _mod_exp(c, sk.lam, n_sq)
     return (u - 1) // sk.n * sk.mu % sk.n
 
 

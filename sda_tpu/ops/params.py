@@ -44,8 +44,15 @@ def is_prime(n: int, rng=None) -> bool:
         d //= 2
         r += 1
 
+    # Paillier keygen feeds 1024-bit candidates through here; OpenSSL's
+    # modexp is ~5-6x python pow at that size. Small (field-modulus)
+    # candidates stay on python pow — ctypes round-trips would dominate.
+    from ..native.bignum import best_mod_exp
+
+    _pow = best_mod_exp(min_bits=128)
+
     def strong_probable_prime(a: int) -> bool:
-        x = pow(a, d, n)
+        x = _pow(a, d, n)
         if x in (1, n - 1):
             return True
         for _ in range(r - 1):

@@ -74,8 +74,9 @@ def uniform_bits_device(key, shape, nbits: int):
 
 
 def uniform_bits_device_pair(key, shape, nbits: int):
-    """``uniform_bits_device`` for ``32 < nbits <= 62``, returned as a
-    ``(hi, lo)`` pair of uint32 tensors with value ``hi·2³² + lo``.
+    """``uniform_bits_device`` for ``32 <= nbits <= 62``, returned as a
+    ``(hi, lo)`` pair of uint32 tensors with value ``hi·2³² + lo``
+    (``nbits == 32`` yields an all-zero hi half — still exact).
 
     The value never exists as an int64 on device: wide (61-bit) hot paths
     consume the halves directly in native 32-bit lanes
@@ -85,8 +86,8 @@ def uniform_bits_device_pair(key, shape, nbits: int):
     import jax.numpy as jnp
     from jax import random
 
-    if not (32 < nbits <= 62):
-        raise ValueError(f"pair draw needs 32 < nbits <= 62, got {nbits}")
+    if not (32 <= nbits <= 62):
+        raise ValueError(f"pair draw needs 32 <= nbits <= 62, got {nbits}")
     hi = random.bits(key, shape=shape, dtype=jnp.uint32) & jnp.uint32(
         (1 << (nbits - 32)) - 1
     )
