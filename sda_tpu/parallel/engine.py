@@ -320,6 +320,45 @@ class TpuAggregator:
         )
         return jax.jit(mapped)
 
+    def sharded_limb_accumulators(self):
+        """Wide-modulus sharded fabric (BASELINE config 5 is 61-bit on
+        v5e-8): each device runs the fused limb share+combine over its
+        participant shard, partial accumulators psum over ``p`` — tiny
+        ``(W, B, n)`` int64 tensors riding ICI — and the exact mod-p
+        recombine of the reduced accumulator happens once on host
+        (``limbmatmul.limb_recombine_host``), exactly like the single-chip
+        streaming bench epilogue.
+
+        Exactness: per-device partials are bounded by ``C_local·L·K·127²``;
+        the psum multiplies by the number of participant shards, so int64
+        stays exact up to ~5e12 total participants — no rem needed on
+        device at all.
+
+        Returns fn(secrets_sharded, key) -> (W, B, n) int64 accumulators
+        (replicated over ``p``, sharded over ``d`` on the B axis). Feed
+        ``limb_recombine_host(acc, p).T`` then ``reconstruct``.
+        """
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        plan = self.plan
+
+        def local_step(secrets, key):
+            key = fold_mesh_axes(key, self.mesh)
+            acc = share_combine_limb(secrets, key, plan)  # (W, b_local, n)
+            return lax.psum(acc, axis_name="p")
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            # in_specs requires a "d" axis, so no d-less fallback here
+            in_specs=(P("p", "d"), P()),
+            out_specs=P(None, "d", None),
+            check_vma=False,
+        )
+        return jax.jit(mapped)
+
     def sharded_clerk_sums(self):
         """Build the jitted sharded share+combine step over the mesh.
 
