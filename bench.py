@@ -98,9 +98,10 @@ def main() -> int:
         if getattr(args, name) is None:
             setattr(args, name, value)
 
-    import jax
+    from sda_tpu.ops.jaxcfg import ensure_x64, sync_platform_to_env
 
-    from sda_tpu.ops.jaxcfg import ensure_x64
+    sync_platform_to_env()
+    import jax
 
     ensure_x64()
     import jax.numpy as jnp

@@ -17,3 +17,21 @@ def ensure_x64() -> None:
 
     jax.config.update("jax_enable_x64", True)
     _done = True
+
+
+def sync_platform_to_env() -> None:
+    """Re-assert the JAX_PLATFORMS env var into jax config.
+
+    This image's axon sitecustomize writes ``jax_platforms`` straight into
+    jax config at interpreter start, shadowing a caller's JAX_PLATFORMS
+    env (e.g. the driver's CPU-mesh dry run, CI smoke runs). Call before
+    any backend initialization; no-op when the env var is unset. The one
+    definition used by bench.py and __graft_entry__.py.
+    """
+    import os
+
+    env = os.environ.get("JAX_PLATFORMS")
+    if env:
+        import jax
+
+        jax.config.update("jax_platforms", env)

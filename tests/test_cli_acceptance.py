@@ -23,3 +23,46 @@ def test_simple_cli_example():
     )
     assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
     assert "result: 0 2 2 4 4 6 6 8 8 10" in proc.stdout, proc.stdout
+
+
+def test_bench_cpu_smoke_all_engines():
+    """The driver's bench entry must never rot: run every engine path at
+    tiny sizes on CPU (subprocess, so the forced-cpu env doesn't leak) and
+    require the self-verification line plus a well-formed JSON metric."""
+    import json
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    # sys.path rather than endswith("site-packages"): Debian-style layouts
+    # use dist-packages, and .pth-injected dirs matter too
+    dep_paths = [p for p in sys.path if p and not p.startswith(str(repo))]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        # -S skips site processing (this image's sitecustomize dials a TPU
+        # relay at every interpreter start — a CPU smoke test must not
+        # depend on it); add the dependency paths back explicitly
+        PYTHONPATH=os.pathsep.join(dep_paths + [str(repo)]),
+    )
+    # --quick pins the narrow 31-bit sumfirst branch (the bare default
+    # would force --wide and duplicate that case)
+    for extra in (["--quick"], ["--wide"], ["--engine", "participant"]):
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-S",
+                str(repo / "bench.py"),
+                "--participants", "2000", "--dim", "60", "--chunk", "1000",
+                *extra,
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=repo,
+            timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "verified" in out.stderr
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["unit"] == "shared_elements_per_second"
+        assert line["value"] > 0
