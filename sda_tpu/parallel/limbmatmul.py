@@ -167,6 +167,15 @@ def limb_partials_const(A, stacks, p: int):
         [((x >> x.dtype.type(7 * i)) & seven).astype(jnp.int8) for i in range(L)],
         axis=-1,
     )  # (M, L*K)
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # XLA's CPU emitter mis-fuses the int64->int8 limb extraction into
+        # the int8 dot for some degenerate shapes (k=1 wide), producing
+        # invalid IR ("add i32, i8"). A barrier cuts that fusion; the TPU
+        # path (where a_limbs materializes for the L dots anyway) is left
+        # untouched.
+        a_limbs = lax.optimization_barrier(a_limbs)
     partials = [
         lax.dot_general(
             a_limbs,

@@ -1,0 +1,127 @@
+"""Randomized property sweep of the TPU aggregation fabric: random packed
+parameter sets, field widths, shapes, and dropout subsets through
+``TpuAggregator`` (single-device and sharded) must always reconstruct the
+exact modular sum. The device-plane analog of test_property_fuzz's
+protocol-plane sweep. Deterministic seeds — failures reproduce exactly.
+"""
+
+import numpy as np
+import pytest
+
+from sda_tpu.ops import find_packed_parameters
+from sda_tpu.ops.modular import positive
+from sda_tpu.protocol import PackedShamirSharing
+
+# (secret_count, privacy_threshold, share_count): k+t+1 a power of two,
+# n+1 a power of three, n >= t+k (SURVEY §2.2 domain structure)
+PARAM_SETS = [(1, 2, 8), (3, 4, 8), (5, 2, 8), (7, 8, 26)]
+
+
+def _scheme(rng, bits):
+    k, t, n = PARAM_SETS[int(rng.integers(0, len(PARAM_SETS)))]
+    p, w2, w3 = find_packed_parameters(k, t, n, min_modulus_bits=bits, seed=int(rng.integers(0, 3)))
+    return PackedShamirSharing(k, n, t, p, w2, w3)
+
+
+def _plain(secrets, p):
+    return np.array(
+        [sum(int(v) for v in secrets[:, j]) % p for j in range(secrets.shape[1])],
+        dtype=np.int64,
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_single_device_random_params_and_dropout(seed):
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator
+
+    rng = np.random.default_rng(100 + seed)
+    bits = int(rng.choice([20, 30]))
+    scheme = _scheme(rng, bits)
+    p = scheme.prime_modulus
+    dim = int(rng.integers(1, 50))
+    P = int(rng.integers(1, 20))
+    secrets = rng.integers(0, p, size=(P, dim)).astype(np.int64)
+
+    # random surviving subset of minimal-or-larger size
+    thresh = scheme.reconstruction_threshold
+    size = int(rng.integers(thresh, scheme.share_count + 1))
+    indices = sorted(rng.choice(scheme.share_count, size=size, replace=False).tolist())
+
+    import jax.numpy as jnp
+
+    agg = TpuAggregator(scheme, dim, use_limbs=bool(rng.integers(0, 2)))
+    out = agg.secure_sum(jnp.asarray(secrets), random.key(seed), indices=indices)
+    np.testing.assert_array_equal(positive(np.asarray(out), p), _plain(secrets, p))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_sharded_random_shapes(seed):
+    import jax
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator, make_mesh, shard_participants
+    from sda_tpu.parallel.engine import verified_step
+
+    assert len(jax.devices()) == 8
+    rng = np.random.default_rng(200 + seed)
+    scheme = _scheme(rng, 25)
+    p = scheme.prime_modulus
+    k = scheme.input_size
+    d_size = 2
+    mesh = make_mesh(p_size=4, d_size=d_size)
+    dim = k * d_size * int(rng.integers(1, 5))
+    P = 4 * int(rng.integers(1, 5))
+    secrets = rng.integers(0, p, size=(P, dim)).astype(np.int64)
+
+    import jax.numpy as jnp
+
+    agg = TpuAggregator(scheme, dim, mesh=mesh)
+    sums_fn = (
+        agg.sharded_clerk_sums()
+        if rng.integers(0, 2)
+        else agg.sharded_clerk_sums_all_to_all()
+    )
+    step = verified_step(agg, sums_fn)
+    out, plain = step(shard_participants(jnp.asarray(secrets), mesh), random.key(seed))
+    np.testing.assert_array_equal(
+        positive(np.asarray(out), p), positive(np.asarray(plain), p)
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sharded_wide_random_shapes(seed):
+    import jax
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator, make_mesh, shard_participants
+    from sda_tpu.parallel.engine import reconstruct
+    from sda_tpu.parallel.limbmatmul import limb_recombine_host
+
+    assert len(jax.devices()) == 8
+    rng = np.random.default_rng(300 + seed)
+    scheme = _scheme(rng, 60)
+    p = scheme.prime_modulus
+    k = scheme.input_size
+    d_size = 2
+    mesh = make_mesh(p_size=4, d_size=d_size)
+    dim = k * d_size * int(rng.integers(1, 4))
+    P = 4 * int(rng.integers(1, 4))
+    secrets = (p - rng.integers(1, 10_000, size=(P, dim))).astype(np.int64)
+
+    import jax.numpy as jnp
+
+    agg = TpuAggregator(scheme, dim, mesh=mesh)
+    acc = np.asarray(
+        agg.sharded_limb_accumulators()(
+            shard_participants(jnp.asarray(secrets), mesh), random.key(seed)
+        )
+    )
+    clerk_sums = limb_recombine_host(acc, p).T
+    thresh = scheme.reconstruction_threshold
+    indices = sorted(
+        rng.choice(scheme.share_count, size=thresh, replace=False).tolist()
+    )
+    out = reconstruct(jnp.asarray(clerk_sums), indices, scheme, dim)
+    np.testing.assert_array_equal(positive(np.asarray(out), p), _plain(secrets, p))
