@@ -320,6 +320,37 @@ class TpuAggregator:
         )
         return jax.jit(mapped)
 
+    def _limb_accumulator_local_step(self, psum_axes):
+        """Shared per-device body of the wide-modulus fabric: fused limb
+        share+combine, then int64 partial psums over ``psum_axes`` in
+        order (single-slice: ('p',); hybrid: ('p', 'h') — ICI before
+        DCN). One definition so overflow-bound or chunking fixes apply to
+        every fabric at once."""
+        from jax import lax
+
+        plan = self.plan
+        mesh = self.mesh
+
+        def local_step(secrets, key):
+            key = fold_mesh_axes(key, mesh)
+            acc = share_combine_limb(secrets, key, plan)  # (W, b_local, n)
+            for ax in psum_axes:
+                acc = lax.psum(acc, axis_name=ax)
+            return acc
+
+        return local_step
+
+    def validate_d_sharding(self, dim: int) -> None:
+        """With a sharded dim axis every d-shard must hold whole batches;
+        unsharded (d=1) keeps the usual zero-pad/truncate tail handling."""
+        d_size = self.mesh.shape.get("d", 1)
+        k = self.plan.input_size
+        if d_size > 1 and dim % (k * d_size) != 0:
+            raise ValueError(
+                f"dim {dim} must divide over input_size {k} x d={d_size} "
+                "so every d-shard holds whole batches"
+            )
+
     def sharded_limb_accumulators(self):
         """Wide-modulus sharded fabric (BASELINE config 5 is 61-bit on
         v5e-8): each device runs the fused limb share+combine over its
@@ -339,18 +370,10 @@ class TpuAggregator:
         ``limb_recombine_host(acc, p).T`` then ``reconstruct``.
         """
         import jax
-        from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        plan = self.plan
-
-        def local_step(secrets, key):
-            key = fold_mesh_axes(key, self.mesh)
-            acc = share_combine_limb(secrets, key, plan)  # (W, b_local, n)
-            return lax.psum(acc, axis_name="p")
-
         mapped = jax.shard_map(
-            local_step,
+            self._limb_accumulator_local_step(("p",)),
             mesh=self.mesh,
             # in_specs requires a "d" axis, so no d-less fallback here
             in_specs=(P("p", "d"), P()),

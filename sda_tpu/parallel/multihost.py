@@ -111,14 +111,7 @@ def hierarchical_clerk_sums(scheme, dim: int, mesh):
 
     agg = TpuAggregator(scheme, dim, mesh=mesh)
     plan = agg.plan
-    d_size = mesh.shape.get("d", 1)
-    if d_size > 1 and dim % (plan.input_size * d_size) != 0:
-        # with a sharded dim axis every d-shard must hold whole batches;
-        # unsharded (d=1) keeps the usual zero-pad/truncate tail handling
-        raise ValueError(
-            f"dim {dim} must divide over input_size {plan.input_size} x "
-            f"d={d_size} so every d-shard holds whole batches"
-        )
+    agg.validate_d_sharding(dim)
     import jax.numpy as jnp
 
     from .engine import fold_mesh_axes
@@ -138,6 +131,39 @@ def hierarchical_clerk_sums(scheme, dim: int, mesh):
         mesh=mesh,
         in_specs=(P(("h", "p"), d_spec), P()),
         out_specs=P(None, d_spec),  # clerk sums replicated; B stays d-sharded
+        check_vma=False,
+    )
+    return agg, jax.jit(mapped)
+
+
+def hierarchical_limb_accumulators(scheme, dim: int, mesh):
+    """Wide-modulus (61-bit) twin of :func:`hierarchical_clerk_sums`.
+
+    Per-device fused limb share+combine (no mod ops on device — see
+    ``engine.sharded_limb_accumulators``), int64 partial psum over ``p``
+    (ICI), then over ``h`` — the only DCN traffic is the tiny
+    ``(W, B_local, n)`` accumulator. Epilogue: one exact host
+    ``limb_recombine_host(acc, p).T`` then ``reconstruct``. int64 stays
+    exact to ~5e12 total participants.
+
+    Returns ``(agg, fn)`` with ``fn(secrets_sharded, key) -> (W, B, n)``
+    int64 accumulators (replicated; B d-sharded).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from .engine import TpuAggregator
+
+    agg = TpuAggregator(scheme, dim, mesh=mesh)
+    agg.validate_d_sharding(dim)
+
+    d_spec = "d" if "d" in mesh.axis_names else None
+    mapped = jax.shard_map(
+        # ICI ("p") before DCN ("h"): only the tiny accumulator crosses hosts
+        agg._limb_accumulator_local_step(("p", "h")),
+        mesh=mesh,
+        in_specs=(P(("h", "p"), d_spec), P()),
+        out_specs=P(None, d_spec, None),
         check_vma=False,
     )
     return agg, jax.jit(mapped)

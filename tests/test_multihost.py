@@ -125,3 +125,36 @@ def test_fold_mesh_axes_distinct_per_device():
     )(jax.random.key(0))
     rows = {tuple(np.asarray(k)) for k in keys}
     assert len(rows) == 8, "mesh devices derived colliding PRNG keys"
+
+
+def test_hierarchical_wide_limb_accumulators():
+    """Wide (61-bit) modulus on the hybrid mesh: per-device limb
+    accumulators psum over ICI then DCN; one exact host recombine; the
+    revealed aggregate equals the plaintext sum."""
+    import jax
+    import jax.numpy as jnp
+
+    from sda_tpu.ops import find_packed_parameters
+    from sda_tpu.parallel.engine import reconstruct
+    from sda_tpu.parallel.limbmatmul import limb_recombine_host
+    from sda_tpu.parallel.multihost import hierarchical_limb_accumulators
+    from sda_tpu.protocol import PackedShamirSharing
+
+    p, w2, w3 = find_packed_parameters(3, 4, 8, min_modulus_bits=60, seed=1)
+    scheme = PackedShamirSharing(3, 8, 4, p, w2, w3)
+    mesh = make_hybrid_mesh(h_size=2, p_size=2, d_size=2)
+    dim = 3 * 2 * 3  # divisible by k * d_size
+    secrets = (
+        p - np.random.default_rng(6).integers(1, 5000, size=(8, dim))
+    ).astype(np.int64)
+
+    _, fn = hierarchical_limb_accumulators(scheme, dim, mesh)
+    acc = np.asarray(
+        fn(shard_participants_hybrid(jnp.asarray(secrets), mesh), jax.random.key(5))
+    )
+    clerk_sums = limb_recombine_host(acc, p).T
+    out = reconstruct(jnp.asarray(clerk_sums), [1, 2, 3, 4, 5, 6, 7], scheme, dim)
+    want = np.array(
+        [sum(int(v) for v in secrets[:, j]) % p for j in range(dim)], dtype=np.int64
+    )
+    np.testing.assert_array_equal(positive(np.asarray(out), p), want)
