@@ -129,3 +129,55 @@ class SecureHistogram:
         counts nonnegative and wraparound-guarded, so the residues ARE the
         counts) — no float round trip, exact for any permitted cohort."""
         return self.fed.reveal_field_sum(recipient, aggregation_id, n_submitted)
+
+
+def quantiles_from_histogram(counts, lo: float, hi: float, qs) -> np.ndarray:
+    """Quantile estimates from equal-width bin ``counts`` over ``[lo, hi)``.
+
+    Standard federated-analytics quantile sketch: the exact cohort
+    histogram (SecureHistogram) determines each quantile to within one
+    bin width; linear interpolation inside the containing bin gives the
+    conventional point estimate. No individual values are ever revealed —
+    only the (secure-summed) counts enter.
+
+    ``qs`` in [0, 1]; returns float64 estimates, one per q. Empty cohorts
+    raise (no data, no quantiles).
+    """
+    counts = np.asarray(counts, dtype=np.float64).reshape(-1)
+    if counts.sum() <= 0:
+        raise ValueError("empty histogram: no quantiles")
+    qs = np.asarray(list(qs), dtype=np.float64)  # materialize: qs may be an iterator
+    bins = len(counts)
+    width = (hi - lo) / bins
+    cum = np.cumsum(counts)
+    total = cum[-1]
+    out = np.empty(len(qs), dtype=np.float64)
+    for i, q in enumerate(qs):
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        target = q * total
+        b = int(np.searchsorted(cum, target, side="left"))
+        b = min(b, bins - 1)
+        # searchsorted lands on the leading cum==0 plateau for q=0 (and on
+        # any empty-bin boundary): advance to the bin that actually holds
+        # the target's mass so the one-bin-width error bound holds
+        while counts[b] == 0 and b < bins - 1 and cum[b] < total:
+            b += 1
+        prev = cum[b - 1] if b > 0 else 0.0
+        inbin = counts[b]
+        frac = 0.0 if inbin == 0 else (target - prev) / inbin
+        out[i] = lo + (b + min(max(frac, 0.0), 1.0)) * width
+    return out
+
+
+class SecureQuantiles(SecureHistogram):
+    """Cohort quantiles (median, p95, ...) via the exact secure histogram.
+
+    Same round protocol as SecureHistogram; ``finish_quantiles`` returns
+    interpolated estimates with error bounded by one bin width
+    ``(hi - lo) / bins`` — tighten by raising ``bins`` (cost is O(bins)
+    vector length, not participant data)."""
+
+    def finish_quantiles(self, recipient, aggregation_id, n_submitted, qs):
+        counts = self.finish(recipient, aggregation_id, n_submitted)
+        return quantiles_from_histogram(counts, self.lo, self.hi, qs)

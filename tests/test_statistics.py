@@ -5,7 +5,12 @@ import numpy as np
 import pytest
 
 from sda_fixtures import new_client, with_service
-from sda_tpu.models.statistics import SecureHistogram, SecureStatistics
+from sda_tpu.models.statistics import (
+    SecureHistogram,
+    SecureQuantiles,
+    SecureStatistics,
+    quantiles_from_histogram,
+)
 
 
 def _setup(ctx, tmp_path):
@@ -99,3 +104,56 @@ def test_finish_rejects_zero_submissions():
     fed = FederatedAveraging(spec, {"w": np.zeros(2)})
     with pytest.raises(ValueError, match="nothing to reveal"):
         fed.finish_round(object(), object(), 0)
+
+
+def test_quantiles_from_histogram_math():
+    # 10 bins over [0, 10): one count per integer value 0..9
+    counts = np.ones(10)
+    got = quantiles_from_histogram(counts, 0.0, 10.0, [0.0, 0.5, 1.0])
+    np.testing.assert_allclose(got, [0.0, 5.0, 10.0])
+    # all mass in one bin: every quantile lands inside it
+    counts = np.zeros(10)
+    counts[7] = 4
+    got = quantiles_from_histogram(counts, 0.0, 10.0, [0.25, 0.75])
+    assert (7.0 <= got).all() and (got <= 8.0).all()
+    # q=0 / sparse leading bins: the estimate must stay within one bin
+    # width of the true minimum (the leading cum==0 plateau is skipped)
+    got = quantiles_from_histogram(counts, 0.0, 10.0, [0.0])
+    assert 7.0 <= got[0] <= 8.0
+    # one-shot iterators are materialized, not silently consumed
+    got = quantiles_from_histogram(np.ones(10), 0.0, 10.0, (q for q in [0.5]))
+    np.testing.assert_allclose(got, [5.0])
+    with pytest.raises(ValueError, match="empty"):
+        quantiles_from_histogram(np.zeros(4), 0, 1, [0.5])
+    with pytest.raises(ValueError, match="outside"):
+        quantiles_from_histogram(np.ones(4), 0, 1, [1.5])
+
+
+def test_secure_quantiles_round(tmp_path):
+    """End-to-end: cohort median/p90 from a secure-histogram round match
+    numpy quantiles of the pooled data to within one bin width."""
+    rng = np.random.default_rng(21)
+    cohorts = [rng.normal(5.0, 1.0, size=rng.integers(5, 30)) for _ in range(4)]
+    sq = SecureQuantiles(bins=200, lo=0.0, hi=10.0, n_participants=4)
+
+    with with_service() as ctx:
+        recipient, rkey, helpers = _setup(ctx, tmp_path)
+        agg_id = sq.open_round(recipient, rkey)
+        for i, values in enumerate(cohorts):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            sq.submit(part, agg_id, values)
+        sq.close_round(recipient, agg_id)
+        members = {
+            c
+            for c, _ in ctx.service.get_committee(recipient.agent, agg_id).clerks_and_keys
+        }
+        for c in [recipient] + helpers:
+            if c.agent.id in members:
+                c.run_chores(-1)
+        got = sq.finish_quantiles(recipient, agg_id, len(cohorts), [0.5, 0.9])
+
+    pooled = np.concatenate(cohorts)
+    want = np.quantile(pooled, [0.5, 0.9])
+    bin_width = 10.0 / 200
+    assert np.all(np.abs(got - want) <= 2 * bin_width + 1e-9)
