@@ -19,7 +19,7 @@ import numpy as np
 
 from sda_tpu.client import SdaClient
 from sda_tpu.crypto.keystore import Keystore
-from sda_tpu.models import SecureHistogram, SecureStatistics
+from sda_tpu.models import SecureHistogram, SecureStatistics, quantiles_from_histogram
 from sda_tpu.server import new_mem_server
 
 
@@ -71,6 +71,10 @@ def main():
         w.run_chores(-1)
     counts = hist.finish(recipient, agg, len(orgs))
     print("cohort latency histogram:   ", counts.tolist(), f"(n={counts.sum()})")
+
+    # --- query 3: cohort latency quantiles off the same secure histogram
+    p50, p95 = quantiles_from_histogram(counts, 0.0, 10.0, [0.5, 0.95])
+    print(f"cohort latency p50={p50:.2f} p95={p95:.2f} (one-bin-width sketch)")
 
     # sanity: the exact plaintext histogram matches
     want = sum(hist.local_counts(s) for _, _, s in orgs).astype(np.int64)

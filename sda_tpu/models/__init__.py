@@ -17,7 +17,12 @@ from .federated import (
     quantize_update,
     unflatten_pytree,
 )
-from .statistics import SecureHistogram, SecureStatistics
+from .statistics import (
+    SecureHistogram,
+    SecureQuantiles,
+    SecureStatistics,
+    quantiles_from_histogram,
+)
 from .trainer import FederatedTrainer
 
 __all__ = [
@@ -25,7 +30,9 @@ __all__ = [
     "FederatedTrainer",
     "QuantizationSpec",
     "SecureHistogram",
+    "SecureQuantiles",
     "SecureStatistics",
+    "quantiles_from_histogram",
     "dequantize_mean",
     "flatten_pytree",
     "quantize_update",
