@@ -18,6 +18,7 @@ from .federated import (
     unflatten_pytree,
 )
 from .statistics import (
+    SecureFrequency,
     SecureHistogram,
     SecureQuantiles,
     SecureStatistics,
@@ -29,6 +30,7 @@ __all__ = [
     "FederatedAveraging",
     "FederatedTrainer",
     "QuantizationSpec",
+    "SecureFrequency",
     "SecureHistogram",
     "SecureQuantiles",
     "SecureStatistics",

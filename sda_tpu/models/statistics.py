@@ -181,3 +181,41 @@ class SecureQuantiles(SecureHistogram):
     def finish_quantiles(self, recipient, aggregation_id, n_submitted, qs):
         counts = self.finish(recipient, aggregation_id, n_submitted)
         return quantiles_from_histogram(counts, self.lo, self.hi, qs)
+
+
+class SecureFrequency(SecureHistogram):
+    """Exact cohort frequency counts over a categorical domain
+    ``{0, …, domain_size−1}`` — the federated heavy-hitters query for
+    known domains. A category IS its bin (unit-width histogram), so counts
+    are exact; ``finish_top_k`` returns the k most frequent categories
+    with their counts, revealing only cohort totals."""
+
+    def __init__(self, domain_size: int, n_participants: int, **kw):
+        super().__init__(
+            bins=domain_size, lo=0.0, hi=float(domain_size),
+            n_participants=n_participants, **kw,
+        )
+
+    def local_counts(self, values) -> np.ndarray:
+        values = np.asarray(values).reshape(-1)
+        if values.size and (
+            not np.issubdtype(values.dtype, np.integer)
+            or values.min() < 0
+            or values.max() >= self.bins
+        ):
+            raise ValueError(
+                f"categories must be integers in [0, {self.bins})"
+            )
+        if values.size > self.max_values:
+            raise ValueError(f"more than {self.max_values} values")
+        # direct bincount on the validated integers: the parent's float
+        # bin formula floor(v/D*D) can round BELOW v (e.g. v=1, D=49)
+        # and silently credit the wrong category
+        return np.bincount(values, minlength=self.bins).astype(np.float64)
+
+    def finish_top_k(self, recipient, aggregation_id, n_submitted, k):
+        """-> list of (category, count), k most frequent, count-descending
+        (ties broken by category id for determinism)."""
+        counts = self.finish(recipient, aggregation_id, n_submitted)
+        order = np.lexsort((np.arange(len(counts)), -counts))[:k]
+        return [(int(c), int(counts[c])) for c in order]

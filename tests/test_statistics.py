@@ -157,3 +157,46 @@ def test_secure_quantiles_round(tmp_path):
     want = np.quantile(pooled, [0.5, 0.9])
     bin_width = 10.0 / 200
     assert np.all(np.abs(got - want) <= 2 * bin_width + 1e-9)
+
+
+def test_secure_frequency_top_k(tmp_path):
+    """Categorical heavy hitters: exact counts, deterministic top-k, and
+    non-categorical inputs rejected."""
+    from sda_tpu.models.statistics import SecureFrequency
+
+    data = [
+        np.array([1, 1, 2, 7]),
+        np.array([1, 2, 2, 2]),
+        np.array([7, 7, 0]),
+    ]
+    freq = SecureFrequency(domain_size=10, n_participants=3)
+    # the float bin formula floor(v/D*D) rounds below v for v=1, D=49 —
+    # the categorical path must bypass it entirely
+    tricky = SecureFrequency(domain_size=49, n_participants=1)
+    counts = tricky.local_counts(np.array([1]))
+    assert counts[1] == 1 and counts[0] == 0
+    with pytest.raises(ValueError, match="categories"):
+        freq.local_counts(np.array([3.5]))
+    with pytest.raises(ValueError, match="categories"):
+        freq.local_counts(np.array([10]))
+
+    with with_service() as ctx:
+        recipient, rkey, helpers = _setup(ctx, tmp_path)
+        agg_id = freq.open_round(recipient, rkey)
+        for i, values in enumerate(data):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            freq.submit(part, agg_id, values)
+        freq.close_round(recipient, agg_id)
+        members = {
+            c
+            for c, _ in ctx.service.get_committee(recipient.agent, agg_id).clerks_and_keys
+        }
+        for c in [recipient] + helpers:
+            if c.agent.id in members:
+                c.run_chores(-1)
+        top = freq.finish_top_k(recipient, agg_id, len(data), k=3)
+
+    # pooled counts: {1:3, 2:4, 7:3, 0:1} -> top3 = 2(4), then 1 and 7 tie
+    # at 3 broken by id
+    assert top == [(2, 4), (1, 3), (7, 3)]
