@@ -45,13 +45,15 @@ def small_aggregation(recipient, recipient_key) -> Aggregation:
 
 
 def fake_participation(participant_id, agg_id, clerks, pi):
+    """Marker "ciphertexts": [clerk index, participant index hi, lo]."""
     return Participation(
         id=ParticipationId.random(),
         participant=participant_id,
         aggregation=agg_id,
         recipient_encryption=None,
         clerk_encryptions=[
-            (c.id, Encryption(Binary(bytes([ci, pi])))) for ci, c in enumerate(clerks)
+            (c.id, Encryption(Binary(bytes([ci, pi >> 8, pi & 0xFF]))))
+            for ci, c in enumerate(clerks)
         ],
     )
 
@@ -194,3 +196,45 @@ def test_snapshot_spoofing_denied():
             ctx.service.get_snapshot_result(bob, agg_a.id, snap_a.id)
         # bogus snapshot id on the right aggregation: None, not a fabricated result
         assert ctx.service.get_snapshot_result(alice, agg_a.id, SnapshotId.random()) is None
+
+
+def test_transpose_stress_large_cohort():
+    """The server-side transpose is the scalability-critical piece
+    (SURVEY §3.2): 2000 participations x 8 clerks with fake-crypto
+    markers must route every ciphertext to exactly the right clerk in
+    order, on whatever backend the matrix selects (sqlite exercises the
+    streaming SQL transpose)."""
+    n_participants, n_clerks = 2000, 8
+    with with_service() as ctx:
+        agents = [new_full_agent(ctx.service) for _ in range(n_clerks + 1)]
+        alice, alice_key = agents[0]
+        agg = small_aggregation(alice.id, alice_key.body.id)
+        agg.committee_sharing_scheme = AdditiveSharing(share_count=n_clerks, modulus=13)
+        ctx.service.create_aggregation(alice, agg)
+        clerks = ctx.service.suggest_committee(alice, agg.id)[:n_clerks]
+        ctx.service.create_committee(
+            alice,
+            Committee(
+                aggregation=agg.id,
+                clerks_and_keys=[(c.id, c.keys[0]) for c in clerks],
+            ),
+        )
+        for pi in range(n_participants):
+            p, _ = new_full_agent(ctx.service)
+            ctx.service.create_participation(
+                p, fake_participation(p.id, agg.id, clerks, pi)
+            )
+
+        snapshot = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+        ctx.service.create_snapshot(alice, snapshot)
+
+        agent_by_id = {a.id: a for a, _ in agents}
+        for ci, c in enumerate(clerks):
+            job = ctx.service.get_clerking_job(agent_by_id[c.id], c.id)
+            assert len(job.encryptions) == n_participants
+            seen = set()
+            for enc in job.encryptions:
+                raw = bytes(enc.inner)
+                assert raw[0] == ci, "ciphertext routed to the wrong clerk"
+                seen.add((raw[1] << 8) | raw[2])
+            assert seen == set(range(n_participants)), "participants lost/dup"
