@@ -13,7 +13,7 @@ from dataclasses import dataclass
 
 from ..utils.jsondir import JsonDir
 
-from ..protocol import PaillierEncryptionKey, B32, B64
+from ..protocol import B32, PaillierEncryptionKey
 from ..protocol.schemes import EncryptionKey, SigningKey, VerificationKey, _untag
 
 

@@ -37,7 +37,6 @@ int64 only where products/sums require it. The int8-limb MXU path
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass
 
 import numpy as np

@@ -15,7 +15,6 @@ still far cheaper on the MXU than one emulated int64 matmul on the VPU.
 
 from __future__ import annotations
 
-import functools
 
 from ..ops.jaxcfg import ensure_x64
 

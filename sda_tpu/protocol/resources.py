@@ -7,10 +7,10 @@ output byte for byte.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
-from .helpers import Binary, Labelled, Signed
+from .helpers import Labelled, Signed
 from .ids import (
     AgentId,
     AggregationId,

@@ -59,7 +59,6 @@ from ..protocol import (
     Participation,
     PermissionDeniedError,
     Profile,
-    SdaError,
     Snapshot,
     SnapshotId,
     signed_encryption_key_from_json,

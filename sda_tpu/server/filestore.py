@@ -14,9 +14,7 @@ checkpoint/resume story (SURVEY.md §5) and it is preserved here.
 
 from __future__ import annotations
 
-import json
 import os
-from typing import Optional
 
 from ..protocol import (
     Agent,
@@ -35,10 +33,7 @@ from ..protocol import (
 )
 from ..protocol.ids import (
     AgentId,
-    AggregationId,
     ClerkingJobId,
-    EncryptionKeyId,
-    ParticipationId,
     SnapshotId,
 )
 from ..utils.jsondir import ConflictError, JsonDir
