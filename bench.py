@@ -72,6 +72,12 @@ def main() -> int:
         "chip does it in ~15 s steady)",
     )
     parser.add_argument(
+        "--pallas",
+        action="store_true",
+        help="participant engine only: fused Pallas limb kernel (per-block "
+        "share matmul + participant reduce in VMEM; narrow fields)",
+    )
+    parser.add_argument(
         "--quick",
         action="store_true",
         help="smaller 100K x 10K / 31-bit shape (~30 s total) for smoke runs",
@@ -97,6 +103,9 @@ def main() -> int:
     for name, value in zip(("participants", "dim", "chunk"), preset):
         if getattr(args, name) is None:
             setattr(args, name, value)
+    # after preset resolution: args.wide is final here
+    if args.pallas and (args.engine != "participant" or args.no_limbs or args.wide):
+        parser.error("--pallas applies to the narrow-field limb participant engine")
 
     from sda_tpu.ops.jaxcfg import ensure_x64, sync_platform_to_env
 
@@ -251,10 +260,15 @@ def main() -> int:
             secrets = draw_bits(sk, (chunk, dim), nbits)
             if use_limbs:
                 # fused limb path: no 64-bit mul/div on the big tensors
-                acc = lax.rem(
-                    acc + share_combine_limb(secrets, rk, plan, draw=mask_draw),
-                    jnp.int64(p),
-                )
+                if args.pallas:
+                    from sda_tpu.parallel.limb_pallas import share_combine_limb_pallas
+
+                    chunk_acc = share_combine_limb_pallas(
+                        secrets, rk, plan, draw=mask_draw
+                    )
+                else:
+                    chunk_acc = share_combine_limb(secrets, rk, plan, draw=mask_draw)
+                acc = lax.rem(acc + chunk_acc, jnp.int64(p))
             else:
                 shares = share_participants(secrets, rk, plan, False, draw=mask_draw)
                 acc = lax.rem(
@@ -310,7 +324,7 @@ def main() -> int:
                 "value": round(rate, 1),
                 "unit": "shared_elements_per_second",
                 "vs_baseline": round(rate / NORTH_STAR_ELEMS_PER_S_PER_CHIP, 4),
-                "engine": args.engine,
+                "engine": args.engine + ("+pallas" if args.pallas else ""),
                 "modulus_bits": p.bit_length(),
                 "participants": n_chunks * chunk,
                 "dim": dim,

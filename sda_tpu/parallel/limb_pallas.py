@@ -1,0 +1,125 @@
+"""Pallas TPU kernel: fused per-participant limb share matmul + reduce.
+
+The per-participant engine path (bench ``--engine participant``) computes
+every participant's share limb-partials individually — (L, C·nb, n) int32
+— and then reduces over participants. Under XLA those partials round-trip
+HBM between the dot and the reduction. This kernel fuses them: each grid
+step loads one participant block, runs the L const-folded limb dots
+(``limbmatmul.fold_const_limbs``) on the MXU, reduces its block over the
+participant axis in VMEM, and accumulates into the tiny (L, nb, n) output
+— per-participant shares exist (transiently, like the reference's
+per-phone loop) but never touch HBM.
+
+Everything in-kernel is int32: partials are bounded by L·K·127² and the
+participant accumulation by C_total·L·K·127², which must stay < 2^31
+(checked at trace time — the bench chunk of 2000 is well inside). The
+mod-p recombine (int64 multiply + one rem) happens outside on the reduced
+accumulator, exactly like the jnp path.
+
+Narrow fields only (p < 2^31: int32 limb extraction); the wide path keeps
+the jnp formulation. CPU runs use the Pallas interpreter (tests).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.jaxcfg import ensure_x64
+from .limbmatmul import fold_const_limbs
+
+
+def participant_limb_sums_pallas(values, stacks, block_c: int = 250):
+    """(C, nb, K) int32 canonical values -> (L, nb, n) int32 partial sums.
+
+    ``stacks`` from ``fold_const_limbs`` (L, L*K, n) int8. Drop-in for
+    ``limb_partials_const`` + participant reduction with weights 128^m.
+    ``block_c`` participants per grid step (VMEM-sized).
+    """
+    ensure_x64()
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C, nb, K = values.shape
+    L, LK, n = stacks.shape
+    if LK != L * K:
+        raise ValueError(f"stacks contraction {LK} != L*K = {L * K}")
+    if C * LK * 127 * 127 >= (1 << 31):
+        raise ValueError(
+            f"participant accumulation over C={C} overflows int32; chunk first"
+        )
+    if C % block_c != 0:
+        # keep blocks VMEM-sized for odd C: the largest divisor <= block_c
+        # (whole-C would be unbounded VMEM and fail to compile on TPUs)
+        block_c = max(d for d in range(1, block_c + 1) if C % d == 0)
+    n_blocks = C // block_c
+
+    def kernel(values_ref, stacks_ref, out_ref):
+        j = pl.program_id(0)
+        x = values_ref[...].reshape(block_c * nb, K)  # int32 canonical
+        a = jnp.concatenate(
+            [
+                ((x >> jnp.int32(7 * i)) & jnp.int32(0x7F)).astype(jnp.int8)
+                for i in range(L)
+            ],
+            axis=-1,
+        )  # (M, LK) int8
+        for m in range(L):
+            prod = lax.dot_general(
+                a,
+                stacks_ref[m],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # (M, n)
+            red = jnp.sum(prod.reshape(block_c, nb, n), axis=0)  # (nb, n)
+
+            @pl.when(j == 0)
+            def _():
+                out_ref[m] = red
+
+            @pl.when(j > 0)
+            def _():
+                out_ref[m] += red
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (block_c, nb, K), lambda j: (j, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((L, LK, n), lambda j: (0, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (L, nb, n), lambda j: (0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((L, nb, n), jnp.int32),
+        interpret=jax.default_backend() == "cpu",
+    )(values, jnp.asarray(stacks))
+
+
+def share_combine_limb_pallas(secrets, key, plan, draw=None):
+    """Fused-kernel twin of ``engine.share_combine_limb`` for p < 2^31:
+    same (W, b, n) int64 contract (weights 128^m), bit-identical results
+    for the same key/draw."""
+    ensure_x64()
+    import jax.numpy as jnp
+
+    from .engine import _batch_secrets, _device_randomness
+
+    if draw is None:
+        draw = _device_randomness
+    p = plan.modulus
+    if p >= (1 << 31):
+        raise ValueError("pallas participant path is narrow-field only (p < 2^31)")
+    batches = _batch_secrets(secrets, plan)  # (C, b, k)
+    C, nb = batches.shape[0], batches.shape[1]
+    randomness = draw(key, (C, nb, plan.rand_size), p)
+    values = jnp.concatenate(
+        [batches.astype(jnp.int32), randomness.astype(jnp.int32)], axis=-1
+    )
+    stacks = fold_const_limbs(plan.share_matrix.T, p)
+    acc = participant_limb_sums_pallas(values, stacks)
+    return acc.astype(jnp.int64)  # (W=L, b, n)
