@@ -9,6 +9,16 @@ quantization into the aggregation's prime field, and a FedAvg round
 driver over any ``SdaService``.
 """
 
+from .dp import (
+    DPConfig,
+    DPFederatedAveraging,
+    DPSecureHistogram,
+    PrivacyAccount,
+    eps_from_zcdp,
+    noise_multiplier_for,
+    sample_discrete_gaussian,
+    sample_skellam,
+)
 from .federated import (
     FederatedAveraging,
     QuantizationSpec,
@@ -27,6 +37,14 @@ from .statistics import (
 from .trainer import FederatedTrainer
 
 __all__ = [
+    "DPConfig",
+    "DPFederatedAveraging",
+    "DPSecureHistogram",
+    "PrivacyAccount",
+    "eps_from_zcdp",
+    "noise_multiplier_for",
+    "sample_discrete_gaussian",
+    "sample_skellam",
     "FederatedAveraging",
     "FederatedTrainer",
     "QuantizationSpec",

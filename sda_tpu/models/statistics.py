@@ -87,15 +87,20 @@ class SecureHistogram:
         n_participants: int,
         max_values_per_participant: int = 1 << 20,
     ):
-        if not (bins > 0 and hi > lo):
-            raise ValueError("need bins > 0 and hi > lo")
-        self.bins = bins
-        self.lo, self.hi = float(lo), float(hi)
-        self.max_values = max_values_per_participant
+        self._init_geometry(bins, lo, hi, max_values_per_participant)
         self.spec, self.sharing = QuantizationSpec.fitted(
             0, float(max_values_per_participant), n_participants
         )
         self.fed = FederatedAveraging(self.spec, {"counts": np.zeros(bins)})
+
+    def _init_geometry(self, bins, lo, hi, max_values):
+        """Bin geometry shared with subclasses that build their own field
+        (DPSecureHistogram fits a noise-headroom spec instead of ours)."""
+        if not (bins > 0 and hi > lo):
+            raise ValueError("need bins > 0 and hi > lo")
+        self.bins = bins
+        self.lo, self.hi = float(lo), float(hi)
+        self.max_values = max_values
 
     def local_counts(self, values) -> np.ndarray:
         values = np.asarray(values, dtype=np.float64).reshape(-1)

@@ -1,0 +1,294 @@
+"""Distributed differential privacy (models/dp.py): samplers, accounting,
+and exact end-to-end noise flow through the full protocol."""
+
+import math
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, with_service
+from sda_tpu.models.dp import (
+    DPConfig,
+    DPFederatedAveraging,
+    DPSecureHistogram,
+    NOISE_TAIL_SIGMAS,
+    delta_from_zcdp,
+    eps_from_zcdp,
+    l2_clip_vector,
+    noise_multiplier_for,
+    sample_discrete_gaussian,
+    sample_discrete_laplace,
+    sample_skellam,
+    zcdp_rho,
+)
+
+
+# --- samplers ---------------------------------------------------------------
+
+
+def test_discrete_gaussian_moments():
+    rng = np.random.default_rng(7)
+    sigma = 3.7
+    x = sample_discrete_gaussian(sigma, 200_000, rng)
+    assert x.dtype == np.int64
+    assert abs(x.mean()) < 0.05
+    # discrete Gaussian variance is slightly below sigma^2; 3% window
+    assert abs(x.var() / (sigma * sigma) - 1.0) < 0.03
+
+
+def test_discrete_gaussian_matches_pmf():
+    rng = np.random.default_rng(1)
+    sigma = 2.0
+    x = sample_discrete_gaussian(sigma, 300_000, rng)
+    ks = np.arange(-12, 13)
+    pmf = np.exp(-(ks.astype(float) ** 2) / (2 * sigma * sigma))
+    pmf /= pmf.sum()  # support beyond +-12 is ~1e-8 at sigma=2
+    emp = np.array([(x == k).mean() for k in ks])
+    assert np.abs(emp - pmf).max() < 0.004
+
+
+def test_discrete_laplace_symmetry_and_scale():
+    rng = np.random.default_rng(3)
+    t = 4.0
+    x = sample_discrete_laplace(t, 200_000, rng)
+    assert abs(x.mean()) < 0.08
+    # var of discrete Laplace = 2q/(1-q)^2 with q = exp(-1/t)
+    q = math.exp(-1.0 / t)
+    want = 2 * q / (1 - q) ** 2
+    assert abs(x.var() / want - 1.0) < 0.03
+
+
+def test_skellam_moments_and_closure():
+    rng = np.random.default_rng(5)
+    mu = 9.0
+    x = sample_skellam(mu, 200_000, rng)
+    assert abs(x.mean()) < 0.05
+    assert abs(x.var() / mu - 1.0) < 0.03
+    # sum of n draws with mu/n each has variance mu (exact closure)
+    parts = [sample_skellam(mu / 8, 50_000, rng) for _ in range(8)]
+    total = np.sum(parts, axis=0)
+    assert abs(total.var() / mu - 1.0) < 0.05
+
+
+def test_sampler_rejects_bad_params():
+    rng = np.random.default_rng(0)
+    with pytest.raises(ValueError):
+        sample_discrete_gaussian(0.0, 4, rng)
+    with pytest.raises(ValueError):
+        sample_skellam(-1.0, 4, rng)
+    with pytest.raises(ValueError):
+        sample_discrete_laplace(0.0, 4, rng)
+
+
+# --- accounting -------------------------------------------------------------
+
+
+def test_zcdp_conversion_tight_and_consistent():
+    rho = zcdp_rho(l2_sensitivity=3.0, sigma_total=30.0)  # 0.005
+    delta = 1e-6
+    eps = eps_from_zcdp(rho, delta)
+    classic = rho + 2 * math.sqrt(rho * math.log(1 / delta))
+    assert 0 < eps <= classic + 1e-9
+    # the conversion pair is consistent: delta at the returned eps <= target
+    assert delta_from_zcdp(rho, eps) <= delta * 1.01
+    # monotonicity
+    assert eps_from_zcdp(2 * rho, delta) > eps
+    assert eps_from_zcdp(rho, 1e-3) < eps
+
+
+def test_noise_multiplier_inversion():
+    delta = 1e-6
+    for eps_target in (0.5, 1.0, 4.0):
+        z = noise_multiplier_for(eps_target, delta)
+        achieved = eps_from_zcdp(zcdp_rho(1.0, z), delta)
+        assert achieved <= eps_target + 1e-6
+        # not wastefully large: slightly less noise must violate the target
+        worse = eps_from_zcdp(zcdp_rho(1.0, z * 0.98), delta)
+        assert worse > eps_target - 0.02 * eps_target
+
+
+def test_dropout_weakens_privacy():
+    dp = DPConfig(l2_clip=1.0, noise_multiplier=1.0, expected_participants=100)
+    full = dp.account(scale=1 << 16, dim=10)
+    dropped = dp.account(scale=1 << 16, dim=10, n_actual=50)
+    assert dropped.epsilon > full.epsilon
+    assert dropped.sigma_total < full.sigma_total
+
+
+def test_l2_clip_vector():
+    v = np.array([3.0, 4.0])
+    np.testing.assert_allclose(l2_clip_vector(v, 2.5), [1.5, 2.0])
+    np.testing.assert_array_equal(l2_clip_vector(v, 10.0), v)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        DPConfig(l2_clip=0.0, noise_multiplier=1.0, expected_participants=2)
+    with pytest.raises(ValueError):
+        DPConfig(l2_clip=1.0, noise_multiplier=1.0, expected_participants=2,
+                 mechanism="laplace")
+    with pytest.raises(NotImplementedError):
+        DPConfig(l2_clip=1.0, noise_multiplier=1.0, expected_participants=2,
+                 mechanism="skellam").account(scale=1, dim=4)
+
+
+def test_noise_headroom_guard():
+    # a data-only-fitted field must be rejected: it holds the data sum
+    # but not the aggregate noise tail
+    from sda_tpu.models.federated import QuantizationSpec
+
+    dp = DPConfig(l2_clip=2.0, noise_multiplier=1.0, expected_participants=4)
+    spec, _ = QuantizationSpec.fitted(12, 2.0, 4)
+    with pytest.raises(ValueError, match="noise headroom"):
+        DPFederatedAveraging(spec, {"w": np.zeros(8)}, dp)
+
+
+def test_min_party_sigma_guard():
+    # tiny noise split over many parties -> per-party sigma < 1 -> refuse
+    dp = DPConfig(l2_clip=1.0, noise_multiplier=1e-4,
+                  expected_participants=10_000)
+    spec, _ = DPFederatedAveraging.fitted_spec(8, dp, dim=4)
+    with pytest.raises(ValueError, match="min_party_sigma"):
+        DPFederatedAveraging(spec, {"w": np.zeros(4)}, dp)
+
+
+# --- end-to-end through the protocol ---------------------------------------
+
+
+def _setup(ctx, tmp_path):
+    recipient = new_client(tmp_path / "r", ctx.service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(8)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    return recipient, rkey, clerks
+
+
+def test_dp_fedavg_round_exact_noise_flow(tmp_path):
+    """The revealed field sum equals quantized data + replayed noise,
+    bit-exactly — DP rides the integer plane without any drift."""
+    dim, n = 12, 4
+    dp = DPConfig(l2_clip=2.0, noise_multiplier=0.05, expected_participants=n,
+                  delta=1e-6)
+    spec, sharing = DPFederatedAveraging.fitted_spec(12, dp, dim)
+    template = {"w": np.zeros(dim)}
+    fed = DPFederatedAveraging(spec, template, dp)
+
+    rng = np.random.default_rng(11)
+    data = rng.uniform(-1.0, 1.0, size=(n, dim))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = fed.open_round(recipient, rkey, sharing)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            fed.submit_update(part, agg_id, {"w": data[i]},
+                              rng=np.random.default_rng(1000 + i))
+        fed.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        revealed = fed.reveal_field_sum(recipient, agg_id, n)
+
+    # replay: same clip/quantize/noise pipeline, independent of the protocol
+    total = np.zeros(dim, dtype=np.int64)
+    for i in range(n):
+        q = spec.quantize(l2_clip_vector(data[i], dp.l2_clip)).astype(np.int64)
+        noise = dp.party_noise(spec.scale, dim,
+                               np.random.default_rng(1000 + i))
+        total += q + noise
+    np.testing.assert_array_equal(revealed, total % spec.modulus)
+
+    acct = fed.privacy(n)
+    assert acct.n_parties == n and acct.epsilon > 0
+
+
+def test_dp_fedavg_mean_accuracy(tmp_path):
+    """With a small noise multiplier the noisy mean lands within the
+    predicted noise scale of the true mean."""
+    dim, n = 8, 5
+    dp = DPConfig(l2_clip=4.0, noise_multiplier=0.02,
+                  expected_participants=n)
+    spec, sharing = DPFederatedAveraging.fitted_spec(14, dp, dim)
+    fed = DPFederatedAveraging(spec, {"w": np.zeros(dim)}, dp,
+                               rng=np.random.default_rng(0))
+    rng = np.random.default_rng(2)
+    data = rng.uniform(-1, 1, size=(n, dim))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = fed.open_round(recipient, rkey, sharing)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            fed.submit_update(part, agg_id, {"w": data[i]})
+        fed.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        mean = fed.finish_round(recipient, agg_id, n)["w"]
+
+    sigma_mean = dp.sigma_total_field(spec.scale, dim) / (n * spec.scale)
+    # data fits inside the clip ball (|coord|<=1, dim=8 -> norm<=2.83<4)
+    np.testing.assert_allclose(mean, data.mean(axis=0),
+                               atol=6 * sigma_mean + n / spec.scale)
+
+
+def test_dp_histogram_round(tmp_path):
+    bins, n = 5, 4
+    hist = DPSecureHistogram(bins=bins, lo=0.0, hi=5.0, n_participants=n,
+                             noise_multiplier=1.5,
+                             rng=np.random.default_rng(42))
+    datasets = [np.array([0.5]), np.array([1.5]), np.array([1.7]),
+                np.array([4.2])]
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = hist.open_round(recipient, rkey)
+        for i, vals in enumerate(datasets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            hist.submit(part, agg_id, vals,
+                        rng=np.random.default_rng(2000 + i))
+        hist.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        noisy = hist.finish(recipient, agg_id, n)
+
+    # replay the exact field-space pipeline: counts quantize to
+    # counts * 2^f, per-party integer noise replays from the same seeds
+    spec = hist.spec
+    total = np.zeros(bins, dtype=np.int64)
+    for i, v in enumerate(datasets):
+        q = spec.quantize(hist.local_counts(v)).astype(np.int64)
+        total += q + hist.dp.party_noise(spec.scale, bins,
+                                         np.random.default_rng(2000 + i))
+    half = spec.modulus // 2
+    raw = total % spec.modulus
+    centered = np.where(raw > half, raw - spec.modulus, raw)
+    np.testing.assert_array_equal(noisy, centered.astype(np.float64) / spec.scale)
+
+    # the noisy counts are counts-accurate: noise std per bin is
+    # z * sensitivity / scale ~= z * max_values
+    exact = sum(hist.local_counts(v) for v in datasets)
+    assert np.abs(noisy - exact).max() < 12 * 1.5 * 2.0
+
+    acct = hist.privacy(n)
+    assert acct.epsilon > 0
+    assert acct.l2_sensitivity == hist.dp.sensitivity_field(spec.scale, bins)
+
+
+def test_fitted_spec_noise_headroom():
+    dp_small = DPConfig(l2_clip=1.0, noise_multiplier=0.1,
+                        expected_participants=4)
+    dp_big = DPConfig(l2_clip=1.0, noise_multiplier=50.0,
+                      expected_participants=4)
+    spec_s, _ = DPFederatedAveraging.fitted_spec(10, dp_small, dim=8)
+    spec_b, _ = DPFederatedAveraging.fitted_spec(10, dp_big, dim=8)
+    assert spec_b.modulus > spec_s.modulus
+    # headroom covers data + tail-sigma noise per coordinate
+    need = (dp_big.expected_participants * spec_b.scale * dp_big.l2_clip
+            + NOISE_TAIL_SIGMAS * dp_big.sigma_total_field(spec_b.scale, 8))
+    assert need < spec_b.modulus / 2
