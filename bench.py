@@ -31,14 +31,47 @@ is already at north-star per-chip pace.
 """
 
 import argparse
+import contextlib
 import json
 import sys
+import threading
 import time
 
 import numpy as np
 
 
 NORTH_STAR_ELEMS_PER_S_PER_CHIP = (1_000_000 * 100_000) / 60.0 / 8.0
+
+
+@contextlib.contextmanager
+def stage(name: str, interval: float = 30.0):
+    """stderr breadcrumb + watchdog: if the stage blocks (tunneled device
+    acquisition and first compile both can, for minutes), keep printing
+    elapsed time so a hang is attributable to a stage, not the script."""
+    t0 = time.perf_counter()
+    print(f"[bench] {name}...", file=sys.stderr, flush=True)
+    done = threading.Event()
+
+    def tick():
+        while not done.wait(interval):
+            print(
+                f"[bench] {name} still running "
+                f"({time.perf_counter() - t0:.0f}s)",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    t = threading.Thread(target=tick, daemon=True)
+    t.start()
+    try:
+        yield
+    finally:
+        done.set()
+        print(
+            f"[bench] {name} done in {time.perf_counter() - t0:.2f}s",
+            file=sys.stderr,
+            flush=True,
+        )
 
 
 def main() -> int:
@@ -81,6 +114,21 @@ def main() -> int:
         "--quick",
         action="store_true",
         help="smaller 100K x 10K / 31-bit shape (~30 s total) for smoke runs",
+    )
+    parser.add_argument(
+        "--budget",
+        type=float,
+        default=1200.0,
+        help="wall-clock budget in seconds: the participant stream is "
+        "processed in segments and stops early (still verified, metric "
+        "marked partial) once the budget is spent",
+    )
+    parser.add_argument(
+        "--segments",
+        type=int,
+        default=10,
+        help="split the stream into this many jit calls for progress "
+        "reporting and budget checks (same compiled fn each time)",
     )
     args = parser.parse_args()
     if args.engine is None:
@@ -128,7 +176,11 @@ def main() -> int:
     from sda_tpu.parallel.limbmatmul import limb_count
     from sda_tpu.protocol import PackedShamirSharing
 
-    dev = jax.devices()[0]
+    # first device touch: under the axon relay this is a network round
+    # trip that can block for minutes when the remote side is busy —
+    # keep it attributable
+    with stage("acquire device"):
+        dev = jax.devices()[0]
     print(f"device: {dev}", file=sys.stderr)
 
     k, t, n = args.secret_count, args.privacy_threshold, args.share_count
@@ -284,54 +336,103 @@ def main() -> int:
             got = positive(np.asarray(out), p)
             return got if np.array_equal(got, positive(plain, p)) else None
 
+    # segmented execution: the stream runs as n_segments identical jit
+    # calls (one compile), giving per-segment progress lines, a wall-clock
+    # budget check between segments, and a steady-state rate measured
+    # from segment 2 on (segment 1 absorbs the compile) — instead of the
+    # old all-or-nothing double full pass, which was undiagnosable when
+    # the relay ran slow
+    n_segments = max(1, min(args.segments, n_chunks))
+    seg_chunks = n_chunks // n_segments
+    dropped = n_chunks - seg_chunks * n_segments
+    if dropped:
+        print(
+            f"[bench] dropping {dropped} remainder chunks "
+            f"({dropped * chunk} participants) to keep one compiled "
+            "segment shape",
+            file=sys.stderr,
+        )
+
     @jax.jit
-    def run(key):
-        acc = jnp.zeros(acc_shape, dtype=jnp.int64)
-        plain = jnp.zeros((dim,), dtype=jnp.int64)
-        (acc, plain, _), _ = lax.scan(body, (acc, plain, key), jnp.arange(n_chunks))
-        return acc, plain
+    def run_seg(acc, plain, key):
+        (acc, plain, key), _ = lax.scan(
+            body, (acc, plain, key), jnp.arange(seg_chunks)
+        )
+        return acc, plain, key
 
-    def run_to_host(key):
-        acc, plain = run(key)
-        return np.asarray(acc), np.asarray(plain)  # transfer forces completion
+    acc = jnp.zeros(acc_shape, dtype=jnp.int64)
+    plain = jnp.zeros((dim,), dtype=jnp.int64)
+    key = jax.random.key(42)
 
-    t0 = time.perf_counter()
-    run_to_host(jax.random.key(42))
-    compile_and_first = time.perf_counter() - t0
+    bench_t0 = time.perf_counter()
+    with stage(f"compile + segment 1/{n_segments} ({seg_chunks} chunks)"):
+        t0 = time.perf_counter()
+        acc, plain, key = run_seg(acc, plain, key)
+        np.asarray(plain)  # host transfer: the only trustworthy fence on axon
+        compile_and_first = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    acc, plain = run_to_host(jax.random.key(43))
-    steady = time.perf_counter() - t0
+    done_segments = 1
+    steady_elems = 0
+    steady_s = 0.0
+    for _ in range(1, n_segments):
+        if time.perf_counter() - bench_t0 > args.budget:
+            print(
+                f"[bench] budget {args.budget:.0f}s spent after "
+                f"{done_segments}/{n_segments} segments; stopping early",
+                file=sys.stderr,
+            )
+            break
+        t0 = time.perf_counter()
+        acc, plain, key = run_seg(acc, plain, key)
+        np.asarray(plain)
+        dt = time.perf_counter() - t0
+        steady_s += dt
+        steady_elems += seg_chunks * chunk * dim
+        done_segments += 1
+        print(
+            f"[bench] segment {done_segments}/{n_segments}: {dt:.2f}s",
+            file=sys.stderr,
+        )
 
     # reconstruct + verify (any t+k of n clerks; drop one for the dropout path)
-    got = finalize(acc, plain)
+    with stage("reconstruct + verify"):
+        got = finalize(np.asarray(acc), np.asarray(plain))
     if got is None:
         print("VERIFICATION FAILED", file=sys.stderr)
         return 1
 
-    total_elems = n_chunks * chunk * dim
-    rate = total_elems / steady
+    participants_done = done_segments * seg_chunks * chunk
+    if steady_elems:
+        rate = steady_elems / steady_s
+        includes_compile = False
+    else:
+        # single segment (tiny run or budget spent immediately): the only
+        # timing available includes compile — report it, flagged
+        rate = seg_chunks * chunk * dim / compile_and_first
+        includes_compile = True
+    partial = done_segments < n_segments or dropped > 0
     print(
-        f"verified {n_chunks * chunk} participants x {dim} dims "
+        f"verified {participants_done} participants x {dim} dims "
         f"(p={p}, k={k}, t={t}, n={n}); compile+first={compile_and_first:.2f}s "
-        f"steady={steady:.3f}s rate={rate:.3e} elems/s",
+        f"steady={steady_s:.3f}s rate={rate:.3e} elems/s",
         file=sys.stderr,
     )
-    print(
-        json.dumps(
-            {
-                "metric": "packed_shamir_secure_sum_throughput_single_chip",
-                "value": round(rate, 1),
-                "unit": "shared_elements_per_second",
-                "vs_baseline": round(rate / NORTH_STAR_ELEMS_PER_S_PER_CHIP, 4),
-                "engine": args.engine + ("+pallas" if args.pallas else ""),
-                "modulus_bits": p.bit_length(),
-                "participants": n_chunks * chunk,
-                "dim": dim,
-                "steady_s": round(steady, 3),
-            }
-        )
-    )
+    result = {
+        "metric": "packed_shamir_secure_sum_throughput_single_chip",
+        "value": round(rate, 1),
+        "unit": "shared_elements_per_second",
+        "vs_baseline": round(rate / NORTH_STAR_ELEMS_PER_S_PER_CHIP, 4),
+        "engine": args.engine + ("+pallas" if args.pallas else ""),
+        "modulus_bits": p.bit_length(),
+        "participants": participants_done,
+        "dim": dim,
+        "steady_s": round(steady_s, 3),
+    }
+    if partial:
+        result["partial"] = True
+    if includes_compile:
+        result["includes_compile"] = True
+    print(json.dumps(result))
     return 0
 
 

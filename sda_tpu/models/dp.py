@@ -250,6 +250,16 @@ class DPConfig:
             self.expected_participants
         )
 
+    def field_need(self, scale: int, dim: int) -> float:
+        """Per-coordinate magnitude the field must hold without wrapping:
+        the data sum plus the NOISE_TAIL_SIGMAS aggregate-noise margin.
+        Single source of truth for builder (``fitted_spec``), the
+        construction-time guard, and the tests."""
+        return (
+            self.expected_participants * scale * self.l2_clip
+            + NOISE_TAIL_SIGMAS * self.sigma_total_field(scale, dim)
+        )
+
     def account(self, scale: int, dim: int, n_actual: int | None = None) -> PrivacyAccount:
         """Guarantee realized with ``n_actual`` submitters (dropout makes
         the realized σ_total smaller than configured: noise variance is
@@ -328,10 +338,7 @@ class DPFederatedAveraging(FederatedAveraging):
         # a data-only-fitted field (plain QuantizationSpec.fitted) accepts
         # the data sum but wraps under aggregate noise — require the
         # NOISE_TAIL_SIGMAS margin the mechanism was accounted with
-        need = (
-            dp.expected_participants * spec.scale * dp.l2_clip
-            + NOISE_TAIL_SIGMAS * dp.sigma_total_field(spec.scale, self.dim)
-        )
+        need = dp.field_need(spec.scale, self.dim)
         if not need < (spec.modulus - 1) // 2:
             raise ValueError(
                 f"field {spec.modulus} lacks noise headroom: data + "
@@ -344,24 +351,14 @@ class DPFederatedAveraging(FederatedAveraging):
         """(spec, sharing) sized for data sum + NOISE_TAIL_SIGMAS·σ_total.
 
         Mirrors ``QuantizationSpec.fitted`` with the per-coordinate bound
-        inflated so n·2^f·clip_eff ≥ n·2^f·clip + TAIL·σ_total."""
+        inflated so n·2^f·clip_eff equals ``DPConfig.field_need``."""
         scale = 1 << frac_bits
         n = dp.expected_participants
-        sigma_total = dp.sigma_total_field(scale, dim)
-        clip_eff = dp.l2_clip + NOISE_TAIL_SIGMAS * sigma_total / (n * scale)
+        clip_eff = dp.field_need(scale, dim) / (n * scale)
         return QuantizationSpec.fitted(frac_bits, clip_eff, n, **shamir_kw)
 
     def submit_update(self, participant, aggregation_id, update_tree, *, rng=None):
-        from .federated import flatten_pytree
-
-        flat, treedef, shapes = flatten_pytree(update_tree)
-        if treedef != self.treedef:
-            raise ValueError("update pytree structure differs from template")
-        if shapes != self.shapes:
-            raise ValueError(
-                f"update leaf shapes {shapes} differ from template {self.shapes}"
-            )
-        flat = l2_clip_vector(flat, self.dp.l2_clip)
+        flat = l2_clip_vector(self._validated_flat(update_tree), self.dp.l2_clip)
         q = self.spec.quantize(flat).astype(np.int64)
         noise = self.dp.party_noise(
             self.spec.scale, self.dim, self._rng if rng is None else rng
@@ -371,7 +368,20 @@ class DPFederatedAveraging(FederatedAveraging):
         # canonical [0, p) representative either side of zero
         participant.participate((q + noise) % self.spec.modulus, aggregation_id)
 
+    def reveal_field_sum(self, recipient, aggregation_id, n_submitted: int):
+        out = super().reveal_field_sum(recipient, aggregation_id, n_submitted)
+        # remember the realized cohort so privacy() reports the guarantee
+        # the revealed aggregate actually has (dropout shrinks the total
+        # noise: realized sigma_total = sqrt(n_actual) * sigma_party)
+        self._revealed_n = n_submitted
+        return out
+
     def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
+        """Realized guarantee. Defaults to the submitter count of the last
+        reveal when one happened; before any reveal it reports the
+        configured target (``expected_participants``)."""
+        if n_actual is None:
+            n_actual = getattr(self, "_revealed_n", None)
         return self.dp.account(self.spec.scale, self.dim, n_actual)
 
 

@@ -252,9 +252,9 @@ class FederatedAveraging:
         recipient.begin_aggregation(agg.id)
         return agg.id
 
-    def submit_update(self, participant, aggregation_id, update_tree):
-        """Participant: quantize a local update and run full participation."""
-        field_vec, treedef, shapes = flatten_pytree(update_tree)
+    def _validated_flat(self, update_tree) -> np.ndarray:
+        """Flatten an update and verify it matches the template layout."""
+        flat, treedef, shapes = flatten_pytree(update_tree)
         if treedef != self.treedef:
             raise ValueError("update pytree structure differs from template")
         if shapes != self.shapes:
@@ -263,10 +263,15 @@ class FederatedAveraging:
             raise ValueError(
                 f"update leaf shapes {shapes} differ from template {self.shapes}"
             )
+        return flat
+
+    def submit_update(self, participant, aggregation_id, update_tree):
+        """Participant: quantize a local update and run full participation."""
+        flat = self._validated_flat(update_tree)
         # pass the int64 ndarray straight through — participate() takes
         # array-likes; a .tolist() round-trip would allocate one Python
         # int per model parameter
-        participant.participate(self.spec.quantize(field_vec), aggregation_id)
+        participant.participate(self.spec.quantize(flat), aggregation_id)
 
     def close_round(self, recipient, aggregation_id):
         """Recipient: freeze participations + enqueue clerking jobs."""

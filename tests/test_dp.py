@@ -11,7 +11,6 @@ from sda_tpu.models.dp import (
     DPConfig,
     DPFederatedAveraging,
     DPSecureHistogram,
-    NOISE_TAIL_SIGMAS,
     delta_from_zcdp,
     eps_from_zcdp,
     l2_clip_vector,
@@ -204,6 +203,8 @@ def test_dp_fedavg_round_exact_noise_flow(tmp_path):
 
     acct = fed.privacy(n)
     assert acct.n_parties == n and acct.epsilon > 0
+    # after a reveal, privacy() defaults to the realized cohort size
+    assert fed.privacy() == acct
 
 
 def test_dp_fedavg_mean_accuracy(tmp_path):
@@ -289,6 +290,4 @@ def test_fitted_spec_noise_headroom():
     spec_b, _ = DPFederatedAveraging.fitted_spec(10, dp_big, dim=8)
     assert spec_b.modulus > spec_s.modulus
     # headroom covers data + tail-sigma noise per coordinate
-    need = (dp_big.expected_participants * spec_b.scale * dp_big.l2_clip
-            + NOISE_TAIL_SIGMAS * dp_big.sigma_total_field(spec_b.scale, 8))
-    assert need < spec_b.modulus / 2
+    assert dp_big.field_need(spec_b.scale, 8) < spec_b.modulus / 2
