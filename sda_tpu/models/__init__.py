@@ -10,6 +10,9 @@ driver over any ``SdaService``.
 """
 
 from .dp import (
+    ComposedPrivacy,
+    compose_accounts,
+    compose_rhos,
     DPConfig,
     DPFederatedAveraging,
     DPSecureHistogram,
@@ -37,6 +40,9 @@ from .statistics import (
 from .trainer import FederatedTrainer
 
 __all__ = [
+    "ComposedPrivacy",
+    "compose_accounts",
+    "compose_rhos",
     "DPConfig",
     "DPFederatedAveraging",
     "DPSecureHistogram",

@@ -204,6 +204,44 @@ class PrivacyAccount:
     n_parties: int
 
 
+@dataclass(frozen=True)
+class ComposedPrivacy:
+    """Cumulative guarantee over a sequence of releases (zCDP ledger)."""
+
+    epsilon: float
+    delta: float
+    rho: float
+    rounds: int
+
+
+def compose_rhos(rhos, delta: float) -> ComposedPrivacy:
+    """zCDP composition: ρ adds across releases; one tight (ε, δ)
+    conversion at the end — strictly better than summing per-round ε."""
+    rhos = [float(r) for r in rhos]
+    rho = sum(rhos)
+    if math.isinf(rho):
+        # a release without implemented accounting (e.g. Skellam) enters
+        # the ledger as rho=inf: the composed guarantee is honestly
+        # "unbounded", never silently understated
+        return ComposedPrivacy(epsilon=math.inf, delta=delta, rho=rho,
+                               rounds=len(rhos))
+    return ComposedPrivacy(
+        epsilon=eps_from_zcdp(rho, delta), delta=delta, rho=rho,
+        rounds=len(rhos),
+    )
+
+
+def compose_accounts(accounts, delta: float | None = None) -> ComposedPrivacy:
+    """Compose per-release ``PrivacyAccount``s; δ defaults to the loosest
+    (largest) per-release δ, which upper-bounds the composition's."""
+    accounts = list(accounts)
+    if not accounts:
+        raise ValueError("nothing to compose")
+    if delta is None:
+        delta = max(a.delta for a in accounts)
+    return compose_rhos([a.rho for a in accounts], delta)
+
+
 # ---------------------------------------------------------------------------
 # Mechanism configuration
 # ---------------------------------------------------------------------------

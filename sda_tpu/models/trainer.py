@@ -52,6 +52,11 @@ class FederatedTrainer:
         self.checkpoint_dir = checkpoint_dir
         self.apply_update = apply_update or self._fedavg_apply
         self.keep_checkpoints = max(1, keep_checkpoints)
+        # privacy ledger: per-round zCDP rho (filled when `fed` is a DP
+        # driver); persisted with checkpoints so a resumed coordinator
+        # keeps its spent budget
+        self.round_rhos: list = []
+        self.privacy_delta: float = 0.0
 
     @staticmethod
     def _fedavg_apply(global_model, mean_update):
@@ -97,6 +102,8 @@ class FederatedTrainer:
                     round_index=self.round_index,
                     shapes=json.dumps([list(s) for s in shapes]),
                     treedef=str(self.fed.treedef),
+                    privacy_rhos=np.asarray(self.round_rhos, dtype=np.float64),
+                    privacy_delta=self.privacy_delta,
                 )
             os.replace(tmp, path)
         except BaseException:
@@ -142,6 +149,9 @@ class FederatedTrainer:
                 data["flat"], self.fed.treedef, self.fed.shapes
             )
             self.round_index = int(data["round_index"])
+            if "privacy_rhos" in data:  # absent in pre-ledger checkpoints
+                self.round_rhos = [float(r) for r in data["privacy_rhos"]]
+                self.privacy_delta = float(data["privacy_delta"])
         return True
 
     # -- the round loop ------------------------------------------------------
@@ -166,9 +176,39 @@ class FederatedTrainer:
         self.fed.close_round(recipient, agg_id)
         for worker in workers:
             worker.run_chores(-1)
+        # charge the ledger BEFORE the release: reveal irreversibly spends
+        # privacy, so a crash between reveal and the post-apply checkpoint
+        # must never lose the charge (over-counting on a crash-before-
+        # reveal rerun is the safe direction). The pre-reveal save rewrites
+        # this round's checkpoint file with the old model + the new rho.
+        privacy = getattr(self.fed, "privacy", None)
+        if privacy is not None:
+            try:
+                acct = privacy(len(submitters))
+                rho, delta = acct.rho, acct.delta
+            except NotImplementedError:
+                # no implemented accounting for this mechanism (Skellam):
+                # ledger the release as unbounded rather than crash or omit
+                rho, delta = float("inf"), 0.0
+            self.round_rhos.append(rho)
+            self.privacy_delta = max(self.privacy_delta, delta)
+            if self.checkpoint_dir is not None:
+                self.save()
         mean_update = self.fed.finish_round(recipient, agg_id, len(submitters))
         self.global_model = self.apply_update(self.global_model, mean_update)
         self.round_index += 1
         if self.checkpoint_dir is not None:
             self.save()
         return self.global_model
+
+    def cumulative_privacy(self, delta: float | None = None):
+        """Total (ε, δ) spent across all completed DP rounds (zCDP adds;
+        one tight conversion). None when no DP rounds have run — e.g. a
+        plain ``FederatedAveraging`` trainer."""
+        if not self.round_rhos:
+            return None
+        from .dp import compose_rhos
+
+        return compose_rhos(
+            self.round_rhos, self.privacy_delta if delta is None else delta
+        )

@@ -9,6 +9,9 @@ import pytest
 from sda_fixtures import new_client, with_service
 from sda_tpu.models.dp import (
     DPConfig,
+    PrivacyAccount,
+    compose_accounts,
+    compose_rhos,
     DPFederatedAveraging,
     DPSecureHistogram,
     delta_from_zcdp,
@@ -151,6 +154,23 @@ def test_min_party_sigma_guard():
         DPFederatedAveraging(spec, {"w": np.zeros(4)}, dp)
 
 
+def test_compose_rhos_and_accounts():
+    rho = zcdp_rho(1.0, 5.0)
+    c = compose_rhos([rho, rho, rho], 1e-6)
+    assert c.rounds == 3
+    assert abs(c.rho - 3 * rho) < 1e-15
+    assert abs(c.epsilon - eps_from_zcdp(3 * rho, 1e-6)) < 1e-12
+    # tight conversion beats naive per-round epsilon summing
+    assert c.epsilon < 3 * eps_from_zcdp(rho, 1e-6)
+
+    a = PrivacyAccount(1.0, 1e-6, rho, 5.0, 1.0, 4)
+    b = PrivacyAccount(1.0, 1e-5, rho, 5.0, 1.0, 4)
+    cc = compose_accounts([a, b])
+    assert cc.delta == 1e-5 and cc.rounds == 2
+    with pytest.raises(ValueError):
+        compose_accounts([])
+
+
 # --- end-to-end through the protocol ---------------------------------------
 
 
@@ -279,6 +299,107 @@ def test_dp_histogram_round(tmp_path):
     acct = hist.privacy(n)
     assert acct.epsilon > 0
     assert acct.l2_sensitivity == hist.dp.sensitivity_field(spec.scale, bins)
+
+
+def test_dp_trainer_privacy_ledger(tmp_path):
+    """Multi-round DP training: rho accumulates per round, the composed
+    epsilon is tighter than summing, and the ledger survives a resume."""
+    from sda_tpu.models.trainer import FederatedTrainer
+
+    dim, n = 4, 3
+    template = {"w": np.zeros(dim)}
+    dp = DPConfig(l2_clip=1.0, noise_multiplier=0.5, expected_participants=n)
+    spec, sharing = DPFederatedAveraging.fitted_spec(14, dp, dim)
+    fed = DPFederatedAveraging(spec, template, dp, rng=np.random.default_rng(0))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        participants = []
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            participants.append((part, lambda m: {"w": np.full(dim, 0.1)}))
+        trainer = FederatedTrainer(fed, template,
+                                   checkpoint_dir=str(tmp_path / "ck"))
+        for _ in range(2):
+            trainer.run_round(recipient, rkey, sharing, participants,
+                              [recipient] + clerks)
+
+    total = trainer.cumulative_privacy()
+    single = fed.privacy(n)
+    assert total.rounds == 2
+    assert abs(total.rho - 2 * single.rho) < 1e-12
+    assert single.epsilon < total.epsilon < 2 * single.epsilon
+
+    # the ledger is part of the checkpoint: a fresh coordinator resumes it
+    fresh = FederatedTrainer(fed, template, checkpoint_dir=str(tmp_path / "ck"))
+    assert fresh.restore_latest()
+    assert fresh.cumulative_privacy() == total
+
+
+def test_trainer_ledger_charged_before_reveal(tmp_path):
+    """A crash between reveal and the post-apply checkpoint must not lose
+    the privacy charge: the ledger is persisted before finish_round."""
+    from sda_tpu.models.trainer import FederatedTrainer
+
+    dim, n = 4, 3
+    template = {"w": np.zeros(dim)}
+    dp = DPConfig(l2_clip=1.0, noise_multiplier=0.5, expected_participants=n)
+    spec, sharing = DPFederatedAveraging.fitted_spec(14, dp, dim)
+    fed = DPFederatedAveraging(spec, template, dp, rng=np.random.default_rng(0))
+
+    def crashing_apply(model, update):
+        raise RuntimeError("crash after reveal")
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        participants = []
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            participants.append((part, lambda m: {"w": np.full(dim, 0.1)}))
+        trainer = FederatedTrainer(fed, template,
+                                   checkpoint_dir=str(tmp_path / "ck"),
+                                   apply_update=crashing_apply)
+        with pytest.raises(RuntimeError, match="crash after reveal"):
+            trainer.run_round(recipient, rkey, sharing, participants,
+                              [recipient] + clerks)
+
+    fresh = FederatedTrainer(fed, template, checkpoint_dir=str(tmp_path / "ck"))
+    assert fresh.restore_latest()
+    resumed = fresh.cumulative_privacy()
+    assert resumed is not None and resumed.rounds == 1  # charge survived
+    assert fresh.round_index == 0  # but the model round did NOT advance
+
+
+def test_trainer_skellam_rounds_ledger_unbounded(tmp_path):
+    """Skellam has no implemented accounting: rounds must still complete,
+    with the ledger honestly reporting an unbounded epsilon."""
+    import math
+
+    from sda_tpu.models.trainer import FederatedTrainer
+
+    dim, n = 4, 3
+    template = {"w": np.zeros(dim)}
+    dp = DPConfig(l2_clip=1.0, noise_multiplier=0.5, expected_participants=n,
+                  mechanism="skellam")
+    spec, sharing = DPFederatedAveraging.fitted_spec(14, dp, dim)
+    fed = DPFederatedAveraging(spec, template, dp, rng=np.random.default_rng(0))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        participants = []
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            participants.append((part, lambda m: {"w": np.full(dim, 0.1)}))
+        trainer = FederatedTrainer(fed, template)
+        trainer.run_round(recipient, rkey, sharing, participants,
+                          [recipient] + clerks)
+
+    assert trainer.round_index == 1  # the round completed
+    total = trainer.cumulative_privacy()
+    assert math.isinf(total.epsilon) and math.isinf(total.rho)
 
 
 def test_fitted_spec_noise_headroom():
