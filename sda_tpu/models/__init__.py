@@ -25,12 +25,14 @@ from .dp import (
 from .federated import (
     FederatedAveraging,
     QuantizationSpec,
+    WeightedFederatedAveraging,
     dequantize_mean,
     flatten_pytree,
     quantize_update,
     unflatten_pytree,
 )
 from .statistics import (
+    SecureCountDistinct,
     SecureFrequency,
     SecureHistogram,
     SecureQuantiles,
@@ -54,6 +56,8 @@ __all__ = [
     "FederatedAveraging",
     "FederatedTrainer",
     "QuantizationSpec",
+    "SecureCountDistinct",
+    "WeightedFederatedAveraging",
     "SecureFrequency",
     "SecureHistogram",
     "SecureQuantiles",

@@ -201,6 +201,12 @@ class FederatedAveraging:
         self.shapes = shapes
         self.dim = dim
 
+    @property
+    def wire_dimension(self) -> int:
+        """Aggregation vector length on the wire; subclasses that append
+        extra channels (e.g. a weight coordinate) override this."""
+        return self.dim
+
     def open_round(
         self,
         recipient,
@@ -234,12 +240,13 @@ class FederatedAveraging:
             )
         if masking_scheme is None:
             masking_scheme = ChaChaMasking(
-                modulus=self.spec.modulus, dimension=self.dim, seed_bitsize=128
+                modulus=self.spec.modulus, dimension=self.wire_dimension,
+                seed_bitsize=128
             )
         agg = Aggregation(
             id=AggregationId.random(),
             title=title,
-            vector_dimension=self.dim,
+            vector_dimension=self.wire_dimension,
             modulus=self.spec.modulus,
             recipient=recipient.agent.id,
             recipient_key=recipient_key,
@@ -308,3 +315,82 @@ class FederatedAveraging:
         return dequantize_mean(
             field_sum, n_submitted, self.spec, self.treedef, self.shapes
         )
+
+
+class WeightedFederatedAveraging(FederatedAveraging):
+    """FedAvg with per-participant weights — the actual FedAvg algorithm
+    (weight each update by its local sample count), as one secure round.
+
+    Each participant submits ``(w·update, w)`` concatenated into a single
+    field vector; the revealed sums give ``Σw·x / Σw`` — the weighted
+    mean — without revealing any individual's weight or update. The
+    weight rides as one extra coordinate, so it gets the same masking /
+    sharing / sealing as the update itself.
+
+    ``clip`` bounds each |update coordinate| and ``max_weight`` bounds
+    the weight, so the product channel needs ``clip·max_weight`` of
+    per-coordinate headroom — ``fitted`` sizes the field for exactly
+    that. Weights are commonly integer sample counts; fractional weights
+    quantize at the spec's ``frac_bits`` like everything else.
+    """
+
+    def __init__(self, spec: QuantizationSpec, template_tree, clip: float,
+                 max_weight: float):
+        super().__init__(spec, template_tree)
+        if clip <= 0 or max_weight <= 0:
+            raise ValueError("clip and max_weight must be positive")
+        if clip * max_weight > spec.clip or max_weight > spec.clip:
+            raise ValueError(
+                f"field bound {spec.clip} below the w*x channel "
+                f"({clip}*{max_weight}); build with .fitted"
+            )
+        self.clip = float(clip)
+        self.max_weight = float(max_weight)
+
+    @classmethod
+    def fitted(cls, frac_bits: int, clip: float, max_weight: float,
+               n_participants: int, template_tree, **shamir_kw):
+        """(driver, sharing) with the field sized for the w·x channel."""
+        bound = max(clip * max_weight, max_weight)
+        spec, sharing = QuantizationSpec.fitted(
+            frac_bits, bound, n_participants, **shamir_kw
+        )
+        return cls(spec, template_tree, clip, max_weight), sharing
+
+    @property
+    def wire_dimension(self) -> int:
+        return self.dim + 1  # update coordinates + the weight
+
+    def open_round(self, recipient, recipient_key, committee_sharing_scheme,
+                   *, title: str = "weighted-federated-round",
+                   masking_scheme=None):
+        return super().open_round(
+            recipient, recipient_key, committee_sharing_scheme,
+            title=title, masking_scheme=masking_scheme,
+        )
+
+    def submit_update(self, participant, aggregation_id, update_tree,
+                      weight: float):
+        if not 0 < weight <= self.max_weight:
+            raise ValueError(
+                f"weight {weight} outside (0, {self.max_weight}]"
+            )
+        flat = self._validated_flat(update_tree)
+        if np.abs(flat).max(initial=0.0) > self.clip:
+            raise ValueError(
+                f"update coordinates exceed the clip bound {self.clip}"
+            )
+        wire = np.concatenate([flat * weight, [float(weight)]])
+        participant.participate(self.spec.quantize(wire), aggregation_id)
+
+    def finish_round(self, recipient, aggregation_id, n_submitted: int):
+        """-> (weighted-mean pytree, total weight)."""
+        field_sum = self.reveal_field_sum(recipient, aggregation_id, n_submitted)
+        sums = self.spec.dequantize_sum(field_sum)
+        total_weight = float(sums[-1])
+        if total_weight <= 0:
+            raise ValueError("revealed total weight is not positive")
+        mean = unflatten_pytree(
+            sums[: self.dim] / total_weight, self.treedef, self.shapes
+        )
+        return mean, total_weight

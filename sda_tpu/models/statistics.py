@@ -224,3 +224,70 @@ class SecureFrequency(SecureHistogram):
         counts = self.finish(recipient, aggregation_id, n_submitted)
         order = np.lexsort((np.arange(len(counts)), -counts))[:k]
         return [(int(c), int(counts[c])) for c in order]
+
+
+class SecureCountDistinct(SecureHistogram):
+    """Cohort count-distinct over an *unknown or huge* item domain.
+
+    The known-domain case is exact via ``SecureFrequency`` (one bin per
+    category); when the domain is unbounded (URLs, tokens, user ids),
+    each participant instead hashes its locally-distinct items into an
+    ``m``-bin counting sketch (0/1 per bin after local dedupe) and the
+    protocol sums the sketches. The union's distinct count is estimated
+    from the number of untouched bins by linear counting
+    (Whang–Vander-Zanden–Taylor 1990): ``n̂ = -m·ln(z/m)`` with ``z``
+    zero bins — standard error ≈ ``sqrt(m·(exp(n/m) - n/m - 1))/n``,
+    under ~1% for ``m ≥ 2n``. Only the summed sketch is revealed; items
+    never leave a participant, and the hash (BLAKE2b, keyed by an
+    explicit round salt all participants share) is one-way.
+    """
+
+    def __init__(self, m: int, n_participants: int, *, salt: str = "",
+                 max_values_per_participant: int = 1 << 20):
+        self._init_geometry(m, 0.0, float(m), max_values_per_participant)
+        # sketch coordinates are 0/1 per participant (deduped), so the
+        # per-bin sum is at most n_participants — fit the minimal field,
+        # not the histogram default of clip=max_values
+        self.spec, self.sharing = QuantizationSpec.fitted(0, 1.0, n_participants)
+        self.fed = FederatedAveraging(self.spec, {"counts": np.zeros(m)})
+        self.salt = salt
+
+    def _bin_of(self, item) -> int:
+        import hashlib
+
+        # the salt is mixed into the hashed message (blake2b's salt param
+        # silently truncates at 16 bytes, which would alias long salts
+        # sharing a prefix and re-link sketches across rounds)
+        h = hashlib.blake2b(
+            self.salt.encode() + b"\x00" + repr(item).encode(), digest_size=8
+        )
+        return int.from_bytes(h.digest(), "big") % self.bins
+
+    def local_counts(self, items) -> np.ndarray:
+        """Locally-deduped 0/1 sketch of this participant's items."""
+        distinct = set(items)
+        if len(distinct) > self.max_values:
+            raise ValueError(f"more than {self.max_values} values")
+        out = np.zeros(self.bins, dtype=np.float64)
+        out[list({self._bin_of(x) for x in distinct})] = 1.0
+        return out
+
+    @staticmethod
+    def estimate_from_counts(counts) -> float:
+        """Linear-counting estimate off the revealed summed sketch."""
+        counts = np.asarray(counts)
+        m = len(counts)
+        zeros = int(np.count_nonzero(counts == 0))
+        if zeros == 0:
+            # sketch saturated: no unbiased estimate; report the coupon-
+            # collector-style upper limit loudly rather than a number
+            raise ValueError(
+                f"sketch saturated (0 of {m} bins empty): raise m beyond "
+                "~2x the expected distinct count and re-run"
+            )
+        return float(-m * np.log(zeros / m))
+
+    def finish_estimate(self, recipient, aggregation_id, n_submitted) -> float:
+        """-> estimated number of distinct items across the cohort."""
+        counts = self.finish(recipient, aggregation_id, n_submitted)
+        return self.estimate_from_counts(counts)

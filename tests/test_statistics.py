@@ -5,7 +5,9 @@ import numpy as np
 import pytest
 
 from sda_fixtures import new_client, with_service
+from sda_tpu.models.federated import WeightedFederatedAveraging
 from sda_tpu.models.statistics import (
+    SecureCountDistinct,
     SecureHistogram,
     SecureQuantiles,
     SecureStatistics,
@@ -200,3 +202,130 @@ def test_secure_frequency_top_k(tmp_path):
     # pooled counts: {1:3, 2:4, 7:3, 0:1} -> top3 = 2(4), then 1 and 7 tie
     # at 3 broken by id
     assert top == [(2, 4), (1, 3), (7, 3)]
+
+
+# --- weighted federated averaging -------------------------------------------
+
+
+def test_weighted_fedavg_round(tmp_path):
+    """Weighted mean Σw·x/Σw through the full protocol: weights 1/2/5,
+    exact to quantization."""
+    dim = 6
+    template = {"w": np.zeros(dim)}
+    fed, sharing = WeightedFederatedAveraging.fitted(
+        frac_bits=18, clip=2.0, max_weight=10.0, n_participants=4,
+        template_tree=template,
+    )
+    rng = np.random.default_rng(3)
+    data = rng.uniform(-2.0, 2.0, size=(3, dim))
+    weights = [1.0, 2.0, 5.0]
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = fed.open_round(recipient, rkey, sharing)
+        for i in range(3):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            fed.submit_update(part, agg_id, {"w": data[i]}, weight=weights[i])
+        fed.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        mean, total_w = fed.finish_round(recipient, agg_id, 3)
+
+    want = np.average(data, axis=0, weights=weights)
+    tol = 3 * 10.0 / (1 << 18)  # quantization of the w*x channel
+    np.testing.assert_allclose(mean["w"], want, atol=tol)
+    assert abs(total_w - 8.0) < 3 / (1 << 18)
+
+
+def test_weighted_fedavg_validation():
+    template = {"w": np.zeros(2)}
+    fed, _ = WeightedFederatedAveraging.fitted(
+        frac_bits=10, clip=1.0, max_weight=4.0, n_participants=2,
+        template_tree=template,
+    )
+    with pytest.raises(ValueError, match="weight"):
+        fed.submit_update(object(), object(), {"w": np.zeros(2)}, weight=5.0)
+    with pytest.raises(ValueError, match="weight"):
+        fed.submit_update(object(), object(), {"w": np.zeros(2)}, weight=0.0)
+    with pytest.raises(ValueError, match="clip bound"):
+        # per-coordinate clip is enforced regardless of weight
+        fed.submit_update(object(), object(), {"w": np.array([1.5, 0.0])},
+                          weight=1.0)
+
+
+# --- count distinct ---------------------------------------------------------
+
+
+def test_count_distinct_local_sketch_and_salt():
+    a = SecureCountDistinct(m=64, n_participants=2, salt="round-1")
+    b = SecureCountDistinct(m=64, n_participants=2, salt="round-1")
+    s1 = a.local_counts(["x", "y", "x", "x"])  # deduped: 2 items
+    assert s1.sum() <= 2 and set(np.unique(s1)) <= {0.0, 1.0}
+    # same salt -> same binning
+    np.testing.assert_array_equal(s1, b.local_counts(["x", "y"]))
+    # different salt -> different binning (20 items in 64 bins: identical
+    # placements across independent hashes would be astronomically rare);
+    # long salts sharing a 16-byte prefix must ALSO rebin (blake2b's salt
+    # param truncates at 16 bytes; we mix into the message instead)
+    items = [f"it{i}" for i in range(20)]
+    base = SecureCountDistinct(m=64, n_participants=2, salt="round-1")
+    other = SecureCountDistinct(m=64, n_participants=2, salt="round-2")
+    long_a = SecureCountDistinct(m=64, n_participants=2,
+                                 salt="analytics-round-2026-07-30")
+    long_b = SecureCountDistinct(m=64, n_participants=2,
+                                 salt="analytics-round-2026-07-31")
+    assert not np.array_equal(base.local_counts(items),
+                              other.local_counts(items))
+    assert not np.array_equal(long_a.local_counts(items),
+                              long_b.local_counts(items))
+
+
+def test_count_distinct_item_bound_enforced():
+    cd = SecureCountDistinct(m=512, n_participants=2,
+                             max_values_per_participant=3)
+    cd.local_counts(["a", "b", "c", "a"])  # 3 distinct: fine
+    with pytest.raises(ValueError, match="more than 3"):
+        cd.local_counts(["a", "b", "c", "d"])
+
+
+def test_count_distinct_estimator_accuracy():
+    m, n_true = 4096, 500
+    sketch = SecureCountDistinct(m=m, n_participants=1, salt="s")
+    items = [f"item-{i}" for i in range(n_true)]
+    est = SecureCountDistinct.estimate_from_counts(sketch.local_counts(items))
+    assert abs(est - n_true) / n_true < 0.05
+
+
+def test_count_distinct_saturation_raises():
+    with pytest.raises(ValueError, match="saturated"):
+        SecureCountDistinct.estimate_from_counts(np.ones(16))
+
+
+def test_count_distinct_round(tmp_path):
+    """Overlapping item sets across 3 orgs; the union estimate lands near
+    the true distinct count and the summed sketch is exact."""
+    cd = SecureCountDistinct(m=512, n_participants=4, salt="demo")
+    sets = [
+        [f"u{i}" for i in range(0, 80)],
+        [f"u{i}" for i in range(40, 120)],
+        [f"u{i}" for i in range(100, 150)],
+    ]
+    true_distinct = 150
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = cd.open_round(recipient, rkey)
+        for i, items in enumerate(sets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            cd.submit(part, agg_id, items)
+        cd.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        counts = cd.finish(recipient, agg_id, len(sets))
+
+    want = sum(cd.local_counts(s) for s in sets).astype(np.int64)
+    np.testing.assert_array_equal(counts, want)  # protocol is exact
+    est = cd.estimate_from_counts(counts)
+    assert abs(est - true_distinct) / true_distinct < 0.15
