@@ -48,3 +48,44 @@ def open_batch(ciphertexts: list, public_key: bytes, secret_key: bytes) -> list:
     from ..crypto import sodium
 
     return [sodium.seal_open(c, public_key, secret_key) for c in ciphertexts]
+
+
+def _chacha_keys(seed_rows: np.ndarray) -> bytes:
+    """(n, <=8) u32 seed words -> n concatenated 32-byte ChaCha keys
+    (little-endian words, zero-padded — the expand_seed key layout)."""
+    rows = np.asarray(seed_rows, dtype=np.uint32)
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    keys = np.zeros((rows.shape[0], 8), dtype="<u4")
+    keys[:, : rows.shape[1]] = rows
+    return keys.tobytes()
+
+
+def chacha_expand(seed_words, dim: int, modulus: int) -> np.ndarray:
+    """One seed -> (dim,) int64 mask in [0, modulus); bit-identical to
+    ``ops.chacha.expand_seed`` (the fallback when the extension is absent
+    or the modulus is out of its 2^63 range)."""
+    if _ext is not None and 0 < modulus <= (1 << 63):
+        buf = _ext.chacha_expand(_chacha_keys(seed_words), int(dim), int(modulus))
+        return np.frombuffer(buf, dtype="<i8").copy()
+    from ..ops.chacha import expand_seed
+
+    return expand_seed(np.asarray(seed_words, dtype=np.uint32), dim, modulus)
+
+
+def chacha_combine(seed_rows, dim: int, modulus: int) -> np.ndarray:
+    """Sum of every seed's expanded mask, elementwise mod modulus —
+    the reveal hot loop, one C call for the whole cohort."""
+    rows = np.asarray(seed_rows, dtype=np.uint32)
+    if _ext is not None and 0 < modulus <= (1 << 63):
+        buf = _ext.chacha_combine(_chacha_keys(rows), int(dim), int(modulus))
+        return np.frombuffer(buf, dtype="<i8").copy()
+    from ..ops.chacha import expand_seed
+
+    # uint64 accumulate: two values each < m can exceed int64 for moduli
+    # above 2^62, but their uint64 sum is < 2^64 — identical to the C path
+    result = np.zeros(dim, dtype=np.uint64)
+    mu = np.uint64(modulus)
+    for row in rows.reshape(-1, rows.shape[-1]):
+        result = (result + expand_seed(row, dim, modulus).astype(np.uint64)) % mu
+    return result.astype(np.int64)

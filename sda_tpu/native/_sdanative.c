@@ -29,6 +29,10 @@ extern int crypto_box_seal(unsigned char *c, const unsigned char *m,
 extern int crypto_box_seal_open(unsigned char *m, const unsigned char *c,
                                 unsigned long long clen, const unsigned char *pk,
                                 const unsigned char *sk);
+extern int crypto_stream_chacha20_xor_ic(unsigned char *c, const unsigned char *m,
+                                         unsigned long long mlen,
+                                         const unsigned char *n, uint64_t ic,
+                                         const unsigned char *k);
 
 /* ---------------- varint ---------------- */
 
@@ -193,6 +197,110 @@ static PyObject *open_batch(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* ---------------- ChaCha20 mask expansion ----------------
+ *
+ * Bit-identical to sda_tpu/ops/chacha.py expand_seed: classic djb
+ * ChaCha20 keystream (zero nonce, 64-bit counter from 0 — libsodium's
+ * crypto_stream_chacha20 layout), words consumed in order as u64 pairs
+ * (w[2i] << 32) | w[2i+1], rejection-sampled below the largest multiple
+ * of the modulus, reduced mod m. Used for the reveal hot loop: expand
+ * every participant's seed and fold the masks into one running sum.
+ */
+
+#define CHACHA_CHUNK 65536 /* keystream buffer per refill; multiple of 64 */
+
+/* expand one 32-byte key into vals[dim] (mod m), optionally accumulating
+ * into acc[dim] (mod m) instead. Returns 0 on success. */
+static void chacha_expand_key(const unsigned char *key, Py_ssize_t dim,
+                              uint64_t m, int64_t *vals, int64_t *acc) {
+    static const unsigned char nonce[8] = {0};
+    unsigned char block[CHACHA_CHUNK];
+    /* 2^64 mod m == ((uint64_t)-m) % m since (2^64 - m) ≡ 2^64 (mod m);
+     * zone = 2^64 - (2^64 mod m) = largest multiple of m (0 when exact,
+     * in which case no rejection is needed). */
+    uint64_t two64_mod_m = ((uint64_t)0 - m) % m;
+    int reject = two64_mod_m != 0;
+    uint64_t zone = (uint64_t)0 - two64_mod_m;
+    uint64_t counter = 0;
+    size_t pos = 0, have = 0; /* empty buffer: first iteration refills */
+    for (Py_ssize_t i = 0; i < dim;) {
+        if (pos + 8 > have) {
+            /* size the refill to what's left (+1 block of rejection
+             * slack), not the full chunk — small dims would otherwise
+             * pay for 64 KiB of keystream per key */
+            size_t want = (size_t)(dim - i) * 8 + 64;
+            have = want > CHACHA_CHUNK ? CHACHA_CHUNK : (want + 63) / 64 * 64;
+            memset(block, 0, have);
+            crypto_stream_chacha20_xor_ic(block, block, have, nonce,
+                                          counter, key);
+            counter += have / 64;
+            pos = 0;
+        }
+        uint32_t w0, w1;
+        memcpy(&w0, block + pos, 4); /* keystream words are little-endian */
+        memcpy(&w1, block + pos + 4, 4);
+        pos += 8;
+        uint64_t v = ((uint64_t)w0 << 32) | (uint64_t)w1;
+        if (reject && v >= zone) continue; /* zone==0 means no rejection */
+        int64_t r = (int64_t)(v % m);
+        if (acc) {
+            acc[i] = (int64_t)(((uint64_t)acc[i] + (uint64_t)r) % m);
+        } else {
+            vals[i] = r;
+        }
+        i++;
+    }
+}
+
+/* chacha_expand(key32: bytes, dim, modulus) -> bytes of int64 LE */
+static PyObject *chacha_expand(PyObject *self, PyObject *args) {
+    Py_buffer key;
+    Py_ssize_t dim;
+    unsigned long long modulus;
+    if (!PyArg_ParseTuple(args, "y*nK", &key, &dim, &modulus)) return NULL;
+    if (key.len != 32 || dim < 0 || modulus == 0 || modulus > (1ULL << 63)) {
+        PyBuffer_Release(&key);
+        return PyErr_Format(PyExc_ValueError,
+                            "need 32-byte key, dim >= 0, 0 < modulus <= 2^63");
+    }
+    PyObject *res = PyBytes_FromStringAndSize(NULL, dim * 8);
+    if (!res) { PyBuffer_Release(&key); return NULL; }
+    int64_t *out = (int64_t *)PyBytes_AS_STRING(res);
+    Py_BEGIN_ALLOW_THREADS
+    chacha_expand_key((const unsigned char *)key.buf, dim, (uint64_t)modulus,
+                      out, NULL);
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&key);
+    return res;
+}
+
+/* chacha_combine(keys: bytes (n*32), dim, modulus) -> bytes of int64 LE:
+ * elementwise sum mod m of every key's expanded mask. */
+static PyObject *chacha_combine(PyObject *self, PyObject *args) {
+    Py_buffer keys;
+    Py_ssize_t dim;
+    unsigned long long modulus;
+    if (!PyArg_ParseTuple(args, "y*nK", &keys, &dim, &modulus)) return NULL;
+    if (keys.len % 32 != 0 || dim < 0 || modulus == 0 || modulus > (1ULL << 63)) {
+        PyBuffer_Release(&keys);
+        return PyErr_Format(PyExc_ValueError,
+                            "need n*32-byte keys, dim >= 0, 0 < modulus <= 2^63");
+    }
+    Py_ssize_t n = keys.len / 32;
+    PyObject *res = PyBytes_FromStringAndSize(NULL, dim * 8);
+    if (!res) { PyBuffer_Release(&keys); return NULL; }
+    int64_t *acc = (int64_t *)PyBytes_AS_STRING(res);
+    memset(acc, 0, (size_t)dim * 8);
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t s = 0; s < n; s++) {
+        chacha_expand_key((const unsigned char *)keys.buf + s * 32, dim,
+                          (uint64_t)modulus, NULL, acc);
+    }
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&keys);
+    return res;
+}
+
 static PyMethodDef methods[] = {
     {"varint_encode", varint_encode, METH_VARARGS,
      "zigzag-LEB128 encode a buffer of little-endian int64"},
@@ -200,6 +308,10 @@ static PyMethodDef methods[] = {
      "decode a zigzag-LEB128 stream to little-endian int64 bytes"},
     {"seal_batch", seal_batch, METH_VARARGS, "sealed-box encrypt a batch"},
     {"open_batch", open_batch, METH_VARARGS, "sealed-box decrypt a batch"},
+    {"chacha_expand", chacha_expand, METH_VARARGS,
+     "expand one 32-byte ChaCha20 key to int64 mask bytes mod m"},
+    {"chacha_combine", chacha_combine, METH_VARARGS,
+     "sum of expanded masks mod m over n concatenated 32-byte keys"},
     {NULL, NULL, 0, NULL},
 };
 

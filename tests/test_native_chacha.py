@@ -1,0 +1,78 @@
+"""Native ChaCha20 expansion (native/_sdanative.c): bit-identity with the
+numpy twin across moduli, and the masker's combine path."""
+
+import numpy as np
+import pytest
+
+from sda_tpu import native
+from sda_tpu.ops.chacha import expand_seed as expand_seed_np
+from sda_tpu.ops.modular import rust_rem_np
+
+MODULI = [433, 256, (1 << 31) - 1, 2**61, 1152921504606847201, 2**63 - 25]
+
+
+def test_wrapper_parity_with_numpy_twin():
+    """Holds whether or not the extension is built (wrapper falls back)."""
+    rng = np.random.default_rng(1)
+    for m in MODULI:
+        seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+        np.testing.assert_array_equal(
+            native.chacha_expand(seed, 257, m), expand_seed_np(seed, 257, m)
+        )
+
+
+@pytest.mark.skipif(not native.available(), reason="extension not built")
+def test_native_expand_and_combine_bit_identical():
+    rng = np.random.default_rng(2)
+    for m in MODULI:
+        seeds = rng.integers(0, 2**32, size=(6, 4), dtype=np.uint32)
+        # uint64 accumulation: int64 would overflow for m > 2^62
+        want = np.zeros(333, dtype=np.uint64)
+        for s in seeds:
+            e = expand_seed_np(s, 333, m)
+            np.testing.assert_array_equal(native.chacha_expand(s, 333, m), e)
+            want = (want + e.astype(np.uint64)) % np.uint64(m)
+        np.testing.assert_array_equal(
+            native.chacha_combine(seeds, 333, m), want.astype(np.int64)
+        )
+
+
+@pytest.mark.skipif(not native.available(), reason="extension not built")
+def test_fallback_matches_native_exactly():
+    """The pure-Python fallback and the C path must agree bit-for-bit —
+    including moduli above 2^62 where a naive int64 fold overflows, and
+    small dims (right-sized keystream refills)."""
+    rng = np.random.default_rng(5)
+    for m in MODULI:
+        for dim in (3, 64, 500):
+            seeds = rng.integers(0, 2**32, size=(4, 4), dtype=np.uint32)
+            got = native.chacha_combine(seeds, dim, m)
+            ext = native._ext
+            native._ext = None
+            try:
+                fb = native.chacha_combine(seeds, dim, m)
+            finally:
+                native._ext = ext
+            np.testing.assert_array_equal(fb, got)
+
+
+def test_masker_combine_uses_cohort_fold():
+    from sda_tpu.crypto.masking import ChaChaMasker
+
+    masker = ChaChaMasker(modulus=433, dimension=64, seed_bitsize=128)
+    rng = np.random.default_rng(3)
+    secrets = rng.integers(0, 433, size=(3, 64))
+    seeds, maskeds = [], []
+    for row in secrets:
+        seed, masked = masker.mask(row)
+        seeds.append(seed)
+        maskeds.append(masked)
+    combined = masker.combine(seeds)
+    # unmasking the summed masked vectors with the combined mask recovers
+    # the plain sum — the full ChaCha round-trip identity
+    total_masked = rust_rem_np(np.sum(maskeds, axis=0), 433)
+    got = masker.unmask(combined, total_masked)
+    np.testing.assert_array_equal(
+        rust_rem_np(got, 433) % 433, secrets.sum(axis=0) % 433
+    )
+    assert masker.combine([]).tolist() == [0] * 64
