@@ -9,13 +9,13 @@ at once).
 
 from __future__ import annotations
 
-from ..crypto import signing
 from ..ops.modular import positive
 from ..protocol import PackedPaillierEncryptionScheme, ClerkingResult
+from .keys import VerifiedKeys
 from ..utils.metrics import get_metrics
 
 
-class Clerking:
+class Clerking(VerifiedKeys):
     def clerk_once(self) -> bool:
         """Process the next pending job, if any; returns whether one ran."""
         job = self.service.get_clerking_job(self.agent, self.agent.id)
@@ -71,17 +71,13 @@ class Clerking:
             # congruent mod m, so reconstruction is unchanged
             combined = positive(combined, aggregation.modulus)
 
-        # fetch + verify recipient key, re-encrypt the combined vector
-        recipient = self.service.get_agent(self.agent, aggregation.recipient)
-        if recipient is None:
-            raise ValueError("Unknown recipient")
-        signed_key = self.service.get_encryption_key(self.agent, aggregation.recipient_key)
-        if signed_key is None:
-            raise ValueError("Unknown recipient encryption key")
-        if not signing.signature_is_valid(recipient, signed_key):
-            raise ValueError("Signature verification failed for recipient key")
+        # fetch + verify recipient key (cached across jobs — keys.py
+        # VerifiedKeys), re-encrypt the combined vector
+        recipient_key = self._fetch_verified_key(
+            aggregation.recipient, aggregation.recipient_key
+        )
         encryptor = self.crypto.new_share_encryptor(
-            signed_key.body.body, aggregation.recipient_encryption_scheme
+            recipient_key, aggregation.recipient_encryption_scheme
         )
 
         return ClerkingResult(

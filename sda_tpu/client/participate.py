@@ -12,29 +12,17 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..crypto import signing
 from ..protocol import Participation, ParticipationId
+from .keys import VerifiedKeys
 
 
-class Participating:
+class Participating(VerifiedKeys):
     def participate(self, values, aggregation_id) -> None:
         participation = self.new_participation(values, aggregation_id)
         self.upload_participation(participation)
 
     def upload_participation(self, participation) -> None:
         self.service.create_participation(self.agent, participation)
-
-    def _fetch_verified_key(self, agent_id, key_id):
-        """Fetch a signed encryption key + its owner, verify the signature."""
-        signed_key = self.service.get_encryption_key(self.agent, key_id)
-        if signed_key is None:
-            raise ValueError("Unknown encryption key")
-        owner = self.service.get_agent(self.agent, agent_id)
-        if owner is None:
-            raise ValueError("Unknown agent")
-        if not signing.signature_is_valid(owner, signed_key):
-            raise ValueError("Signature verification failed for key")
-        return signed_key.body.body  # the EncryptionKey
 
     def new_participation(self, values, aggregation_id) -> Participation:
         secrets = np.asarray(values, dtype=np.int64)

@@ -387,3 +387,51 @@ def test_malformed_paillier_participation_rejected_at_door(tmp_path):
                 c.run_chores(-1)
         out = recipient.reveal_aggregation(agg.id).positive().values
         np.testing.assert_array_equal(out, [1, 2, 3, 4])
+
+
+def test_verified_key_cache_hits_and_never_caches_failures(tmp_path):
+    """_fetch_verified_key caches only successfully verified keys: repeat
+    lookups skip the service round-trips + Ed25519 verify, while a forged
+    signature keeps raising on every attempt (never enters the cache)."""
+    from sda_fixtures import new_client, with_service
+    from sda_tpu.protocol import Signature, Signed, B64
+
+    with with_service() as ctx:
+        owner = new_client(tmp_path / "o", ctx.service)
+        owner.upload_agent()
+        key = owner.new_encryption_key()
+        owner.upload_encryption_key(key)
+        reader = new_client(tmp_path / "r", ctx.service)
+        reader.upload_agent()
+
+        calls = {"n": 0}
+        orig = ctx.service.get_encryption_key
+
+        def counted(agent, key_id):
+            calls["n"] += 1
+            return orig(agent, key_id)
+
+        ctx.service.get_encryption_key = counted
+        k1 = reader._fetch_verified_key(owner.agent.id, key)
+        k2 = reader._fetch_verified_key(owner.agent.id, key)
+        assert k1 is k2
+        assert calls["n"] == 1  # second lookup came from the cache
+
+        # forge: same key id but a corrupted signature -> raises every
+        # time, and never pollutes the cache for other readers
+        good = orig(reader.agent, key)
+
+        def forged(agent, key_id):
+            return Signed(
+                signature=Signature(B64(bytes(64))),
+                signer=good.signer,
+                body=good.body,
+            )
+
+        ctx.service.get_encryption_key = forged
+        fresh = new_client(tmp_path / "f", ctx.service)
+        fresh.upload_agent()
+        for _ in range(2):
+            with pytest.raises(ValueError, match="Signature verification"):
+                fresh._fetch_verified_key(owner.agent.id, key)
+        assert getattr(fresh, "_verified_keys", {}) == {}
