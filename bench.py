@@ -33,6 +33,7 @@ is already at north-star per-chip pace.
 import argparse
 import contextlib
 import json
+import os
 import sys
 import threading
 import time
@@ -72,6 +73,45 @@ def stage(name: str, interval: float = 30.0):
             file=sys.stderr,
             flush=True,
         )
+
+
+def arm_deadline(seconds: float):
+    """Last-resort watchdog for the pre-measurement window: device
+    acquisition and first compile can block indefinitely when the
+    tunneled device is wedged. If the deadline passes before the first
+    segment lands, emit a diagnosable JSON metric line and hard-exit
+    (a blocked native call can't be interrupted from Python, so the
+    thread prints and ``os._exit``s). Disarmed once measurements exist —
+    from then on --budget governs. ``seconds <= 0`` disables it."""
+    if seconds <= 0:
+        return None
+
+    def fire():
+        print(
+            f"[bench] DEADLINE: no result after {seconds:.0f}s "
+            "(device unreachable or compile wedged)",
+            file=sys.stderr,
+            flush=True,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "packed_shamir_secure_sum_throughput_single_chip",
+                    "value": 0,
+                    "unit": "shared_elements_per_second",
+                    "vs_baseline": 0.0,
+                    "error": f"deadline {seconds:.0f}s exceeded before any "
+                    "measurement (device hang?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def main() -> int:
@@ -130,7 +170,28 @@ def main() -> int:
         help="split the stream into this many jit calls for progress "
         "reporting and budget checks (same compiled fn each time)",
     )
+    parser.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        help="hard wall-clock limit for the pre-measurement window "
+        "(device acquisition + first compile): if nothing has been "
+        "measured by then, print an error-tagged metric line and exit 2 "
+        "instead of hanging forever. 0 disables. Default: "
+        "$SDA_BENCH_DEADLINE or 3000",
+    )
     args = parser.parse_args()
+    if args.deadline is None:
+        try:
+            args.deadline = float(os.environ.get("SDA_BENCH_DEADLINE", 3000))
+        except ValueError:
+            print(
+                f"[bench] ignoring non-numeric SDA_BENCH_DEADLINE="
+                f"{os.environ['SDA_BENCH_DEADLINE']!r}; using 3000",
+                file=sys.stderr,
+            )
+            args.deadline = 3000.0
+    watchdog = arm_deadline(args.deadline)
     if args.engine is None:
         # --no-limbs selects the int64 variant of the per-participant path;
         # honor pre-existing invocations rather than silently ignoring it
@@ -370,6 +431,9 @@ def main() -> int:
         acc, plain, key = run_seg(acc, plain, key)
         np.asarray(plain)  # host transfer: the only trustworthy fence on axon
         compile_and_first = time.perf_counter() - t0
+    # a measurement exists: disarm the hang watchdog; --budget governs now
+    if watchdog is not None:
+        watchdog.cancel()
 
     done_segments = 1
     steady_elems = 0

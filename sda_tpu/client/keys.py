@@ -42,5 +42,6 @@ class VerifiedKeys:
             raise ValueError("Signature verification failed for key")
         if len(cache) >= self._VERIFIED_KEY_CACHE_MAX:
             cache.clear()
-        cache[(agent_id, key_id)] = signed_key.body.body  # the EncryptionKey
-        return cache[(agent_id, key_id)]
+        key_body = signed_key.body.body  # the EncryptionKey
+        cache[(agent_id, key_id)] = key_body
+        return key_body
