@@ -16,6 +16,7 @@ from .dp import (
     DPConfig,
     DPFederatedAveraging,
     DPSecureHistogram,
+    DPSecureStatistics,
     PrivacyAccount,
     eps_from_zcdp,
     noise_multiplier_for,
@@ -48,6 +49,7 @@ __all__ = [
     "DPConfig",
     "DPFederatedAveraging",
     "DPSecureHistogram",
+    "DPSecureStatistics",
     "PrivacyAccount",
     "eps_from_zcdp",
     "noise_multiplier_for",

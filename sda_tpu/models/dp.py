@@ -53,7 +53,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .federated import FederatedAveraging, QuantizationSpec
-from .statistics import SecureHistogram
+from .statistics import SecureHistogram, SecureStatistics
 
 # Field headroom reserved for aggregate noise, in units of sigma_total.
 # Sub-Gaussian tail: P(|noise| > k*sigma) <= 2*exp(-k^2/2) ~ 5e-32 at 12.
@@ -421,6 +421,49 @@ class DPFederatedAveraging(FederatedAveraging):
         if n_actual is None:
             n_actual = getattr(self, "_revealed_n", None)
         return self.dp.account(self.spec.scale, self.dim, n_actual)
+
+
+class DPSecureStatistics(SecureStatistics):
+    """Cohort mean + variance under distributed DP.
+
+    ``SecureStatistics`` (participants submit ``[x, x²]`` per
+    coordinate) over a ``DPFederatedAveraging`` round; validation,
+    round flow, and the variance computation are inherited — only the
+    field fitting (noise headroom) and noise threading differ. The
+    concatenated channel has a deterministic L2 bound for
+    per-coordinate ``|x| ≤ c``: ``||(x, x²)||₂ ≤ sqrt(d·(c² + c⁴))`` —
+    used as the DP clip, so in-bounds submissions are never scaled and
+    the accounted sensitivity is tight for worst-case inputs. Both
+    revealed sums carry noise of std σ_total/2^f per coordinate; the
+    variance estimate inherits it (clamped at 0 by the parent).
+    """
+
+    def __init__(self, dim: int, clip: float, n_participants: int, *,
+                 noise_multiplier: float, delta: float = 1e-6,
+                 frac_bits: int = 16, mechanism: str = "dgauss", rng=None):
+        if clip <= 0:
+            raise ValueError("clip must be positive")
+        self.dim = dim
+        self.clip = float(clip)
+        l2 = math.sqrt(dim * (clip * clip + clip ** 4))
+        self.dp = DPConfig(
+            l2_clip=l2, noise_multiplier=noise_multiplier,
+            expected_participants=n_participants, delta=delta,
+            mechanism=mechanism,
+        )
+        self.spec, self.sharing = DPFederatedAveraging.fitted_spec(
+            frac_bits, self.dp, 2 * dim
+        )
+        template = {"sum": np.zeros(dim), "sumsq": np.zeros(dim)}
+        self.fed = DPFederatedAveraging(self.spec, template, self.dp, rng=rng)
+
+    def submit(self, participant, aggregation_id, values, *, rng=None) -> None:
+        self.fed.submit_update(
+            participant, aggregation_id, self._checked_tree(values), rng=rng
+        )
+
+    def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
+        return self.fed.privacy(n_actual)
 
 
 class DPSecureHistogram(SecureHistogram):

@@ -48,14 +48,18 @@ class SecureStatistics:
             recipient, recipient_key, self.sharing, title="secure-statistics"
         )
 
-    def submit(self, participant, aggregation_id, values) -> None:
+    def _checked_tree(self, values) -> dict:
+        """Validate one submission and build its ``[x, x²]`` channel."""
         values = np.asarray(values, dtype=np.float64)
         if values.shape != (self.dim,):
             raise ValueError(f"expected ({self.dim},) values, got {values.shape}")
         if np.abs(values).max(initial=0.0) > self.clip:
             raise ValueError(f"values exceed clip bound {self.clip}")
+        return {"sum": values, "sumsq": values * values}
+
+    def submit(self, participant, aggregation_id, values) -> None:
         self.fed.submit_update(
-            participant, aggregation_id, {"sum": values, "sumsq": values * values}
+            participant, aggregation_id, self._checked_tree(values)
         )
 
     def close_round(self, recipient, aggregation_id) -> None:

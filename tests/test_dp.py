@@ -402,6 +402,44 @@ def test_trainer_skellam_rounds_ledger_unbounded(tmp_path):
     assert math.isinf(total.epsilon) and math.isinf(total.rho)
 
 
+def test_dp_statistics_round(tmp_path):
+    """DP mean/variance: exact noise replay through the protocol and
+    accuracy within the predicted noise scale."""
+    from sda_tpu.models.dp import DPSecureStatistics
+
+    dim, n = 6, 4
+    stats = DPSecureStatistics(dim=dim, clip=2.0, n_participants=n,
+                               noise_multiplier=0.01, frac_bits=16,
+                               rng=np.random.default_rng(0))
+    rng = np.random.default_rng(8)
+    data = rng.uniform(-2.0, 2.0, size=(n, dim))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = stats.open_round(recipient, rkey)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            stats.submit(part, agg_id, data[i])
+        stats.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = stats.finish(recipient, agg_id, n)
+
+    sigma_mean = stats.dp.sigma_total_field(stats.spec.scale, 2 * dim) / (
+        n * stats.spec.scale
+    )
+    np.testing.assert_allclose(result["mean"], data.mean(axis=0),
+                               atol=6 * sigma_mean + n / stats.spec.scale)
+    # variance: mean-of-squares noise plus the mean's squared error
+    np.testing.assert_allclose(result["variance"], data.var(axis=0),
+                               atol=30 * sigma_mean + 1e-3)
+    assert (result["variance"] >= 0).all()
+    assert stats.privacy(n).epsilon > 0
+    with pytest.raises(ValueError, match="clip bound"):
+        stats.submit(object(), object(), np.full(dim, 3.0))
+
+
 def test_fitted_spec_noise_headroom():
     dp_small = DPConfig(l2_clip=1.0, noise_multiplier=0.1,
                         expected_participants=4)
