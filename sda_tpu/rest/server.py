@@ -92,10 +92,28 @@ class _Handler(BaseHTTPRequestHandler):
     def _caller(self) -> Agent:
         return self.service.server.check_auth_token(self._auth_token())
 
+    #: request body cap — an authed client must not be able to stream
+    #: arbitrary gigabytes into server memory by claiming a huge
+    #: Content-Length. Sized ~30x the largest legitimate participation
+    #: we target (100K dims x 8 clerks ~= 15 MB of sealed JSON).
+    MAX_BODY_BYTES = 512 * 1024 * 1024
+
     def _read_json(self):
-        length = int(self.headers.get("Content-Length") or 0)
-        if length == 0:
-            raise InvalidRequestError("Expected a body")
+        def refuse(msg):
+            # rejecting before draining the body would desync an HTTP/1.1
+            # keep-alive stream (the unread bytes become the "next
+            # request") — drop the connection after responding instead
+            self.close_connection = True
+            raise InvalidRequestError(msg)
+
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            refuse("invalid Content-Length")
+        if length <= 0:
+            refuse("Expected a body")
+        if length > self.MAX_BODY_BYTES:
+            refuse(f"body exceeds the {self.MAX_BODY_BYTES}-byte limit")
         try:
             return json.loads(self.rfile.read(length))
         except json.JSONDecodeError as e:

@@ -123,3 +123,57 @@ def test_auth_and_error_mapping(http_ctx):
     # unknown route -> plain 404, surfaced as an error
     resp = requests.get(f"{base_url}/v1/nope", auth=(str(alice.agent.id), "x"))
     assert resp.status_code == 404 and "Resource-not-found" not in resp.headers
+
+
+def test_malformed_requests_are_400s_not_500s(http_ctx):
+    """Reference parity for the Basic-auth parsing unit tests
+    (server-http/src/lib.rs:345-375) plus body hardening: malformed
+    JSON, wrong-shaped payloads, bogus Content-Length, and oversized
+    bodies are client errors; garbage auth headers are 401s."""
+    _, base_url, tmp_path = http_ctx
+    service = SdaHttpClient(base_url, TokenStore(tmp_path / "t"))
+    alice = new_client(tmp_path / "alice", service)
+    alice.upload_agent()
+    token = TokenStore(tmp_path / "t").get()
+    auth = (str(alice.agent.id), token)
+    url = f"{base_url}/v1/agents/me/keys"
+
+    r = requests.post(url, data=b"{not json", auth=auth,
+                      headers={"Content-Type": "application/json"})
+    assert r.status_code == 400 and "malformed JSON" in r.text
+
+    r = requests.post(url, json={"zzz": 1}, auth=auth)
+    assert r.status_code == 400 and "malformed body" in r.text
+
+    r = requests.post(url, data=b"", auth=auth)
+    assert r.status_code == 400  # empty body
+
+    # unparseable Content-Length: requests normalizes the header, so
+    # speak raw HTTP to actually exercise the int() rejection branch
+    import base64
+    import socket
+    from urllib.parse import urlparse
+
+    parsed = urlparse(base_url)
+    cred = base64.b64encode(f"{auth[0]}:{auth[1]}".encode()).decode()
+    with socket.create_connection((parsed.hostname, parsed.port), timeout=10) as s:
+        s.sendall(
+            b"POST /v1/agents/me/keys HTTP/1.1\r\n"
+            + f"Host: {parsed.hostname}\r\n".encode()
+            + f"Authorization: Basic {cred}\r\n".encode()
+            + b"Content-Length: zzz\r\n\r\n"
+        )
+        status_line = s.makefile("rb").readline()
+    assert b"400" in status_line  # unparseable Content-Length
+
+    r = requests.post(url, data=b"", auth=auth,
+                      headers={"Content-Length": str(1 << 40)})
+    assert r.status_code == 400 and "limit" in r.text  # claimed 1 TiB
+
+    # auth-header parsing: non-base64 credentials and non-Basic schemes
+    r = requests.get(f"{base_url}/v1/agents/{alice.agent.id}",
+                     headers={"Authorization": "Basic !!notb64!!"})
+    assert r.status_code == 401
+    r = requests.get(f"{base_url}/v1/agents/{alice.agent.id}",
+                     headers={"Authorization": "Bearer abc"})
+    assert r.status_code == 401
