@@ -19,16 +19,18 @@ from sda_tpu.protocol import (
 )
 
 
-@pytest.mark.parametrize("backend", ["file", "sqlite"])
-def test_server_restart_mid_protocol(tmp_path, backend):
+def _boot(tmp_path, backend):
     from sda_tpu.server import new_file_server, new_sqlite_server
 
-    def boot():
-        if backend == "file":
-            return new_file_server(tmp_path / "store")
-        return new_sqlite_server(tmp_path / "store.db")
+    if backend == "file":
+        return new_file_server(tmp_path / "store")
+    return new_sqlite_server(tmp_path / "store.db")
 
-    service = boot()
+
+def _run_protocol_to_snapshot(tmp_path, service, title):
+    """Recipient + 3 keyed clerks + 2 participations of [1,2,3,4] over a
+    3-way additive aggregation, ended: snapshot + queued jobs exist.
+    Returns (recipient, clerks, agg)."""
     recipient = new_client(tmp_path / "recipient", service)
     recipient.upload_agent()
     rkey = recipient.new_encryption_key()
@@ -39,7 +41,7 @@ def test_server_restart_mid_protocol(tmp_path, backend):
         c.upload_encryption_key(c.new_encryption_key())
 
     agg = Aggregation(
-        id=AggregationId.random(), title="durable", vector_dimension=4, modulus=433,
+        id=AggregationId.random(), title=title, vector_dimension=4, modulus=433,
         recipient=recipient.agent.id, recipient_key=rkey,
         masking_scheme=NoMasking(),
         committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
@@ -48,16 +50,22 @@ def test_server_restart_mid_protocol(tmp_path, backend):
     )
     recipient.upload_aggregation(agg)
     recipient.begin_aggregation(agg.id)
-
-    parts = [new_client(tmp_path / f"p{i}", service) for i in range(2)]
-    for part in parts:
-        part.upload_agent()
-        part.participate([1, 2, 3, 4], agg.id)
+    for i in range(2):
+        p = new_client(tmp_path / f"p{i}", service)
+        p.upload_agent()
+        p.participate([1, 2, 3, 4], agg.id)
     recipient.end_aggregation(agg.id)  # snapshot + queued jobs exist
+    return recipient, clerks, agg
+
+
+@pytest.mark.parametrize("backend", ["file", "sqlite"])
+def test_server_restart_mid_protocol(tmp_path, backend):
+    service = _boot(tmp_path, backend)
+    recipient, clerks, agg = _run_protocol_to_snapshot(tmp_path, service, "durable")
 
     # --- the server process "crashes"; a new one boots over the same store
     del service
-    service2 = boot()
+    service2 = _boot(tmp_path, backend)
 
     def rebind(client):
         return SdaClient(client.agent, client.crypto.keystore, service2)
@@ -68,3 +76,40 @@ def test_server_restart_mid_protocol(tmp_path, backend):
 
     out = recipient2.reveal_aggregation(agg.id)
     np.testing.assert_array_equal(out.positive().values, [2, 4, 6, 8])
+
+
+@pytest.mark.parametrize("backend", ["file", "sqlite"])
+def test_clerk_crash_before_result_repolls_same_job(tmp_path, backend):
+    """Protocol-level elastic recovery (SURVEY §5 item 4): a job stays
+    queued until a result is posted, so a clerk that polled a job and
+    died is replaced by a fresh process with the same identity that
+    re-polls the SAME job and completes it — exactly once end to end."""
+    service = _boot(tmp_path, backend)
+    recipient, clerks, agg = _run_protocol_to_snapshot(tmp_path, service, "crashy")
+
+    committee = service.get_committee(recipient.agent, agg.id)
+    members = {c for c, _ in committee.clerks_and_keys}
+    crashed = next(c for c in [recipient] + clerks if c.agent.id in members)
+
+    # the clerk polls its job... and "crashes" before posting the result
+    job1 = service.get_clerking_job(crashed.agent, crashed.agent.id)
+    assert job1 is not None
+
+    # a fresh process with the same identity re-polls: SAME job, still
+    # queued. Same identity means same keystore in a real deployment —
+    # the reborn clerk needs its predecessor's decryption keys.
+    reborn = SdaClient(crashed.agent, crashed.crypto.keystore, service)
+    job2 = service.get_clerking_job(reborn.agent, reborn.agent.id)
+    assert job2 is not None and job2.id == job1.id
+
+    # everyone (reborn included) drains; the aggregate is exact
+    for w in [recipient] + clerks:
+        if w.agent.id in members and w.agent.id != crashed.agent.id:
+            w.run_chores(-1)
+    reborn.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    np.testing.assert_array_equal(out, [2, 4, 6, 8])
+
+    # and the job queue is drained: nothing left for anyone
+    for w in [recipient] + clerks:
+        assert service.get_clerking_job(w.agent, w.agent.id) is None
