@@ -200,6 +200,47 @@ class FileAggregationsStore(AggregationsStore):
             if payload is not None:
                 yield Participation.from_json(payload)
 
+    def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
+        # the default parses every member's JSON just to count; the
+        # frozen id list already knows (missing files can't arise: the
+        # membership is snapped from the directory listing itself)
+        return len(self.members.get(snapshot_id) or [])
+
+    #: above this many snapped participations the transpose switches from
+    #: the one-pass in-memory default to per-clerk column scans
+    TRANSPOSE_STREAM_THRESHOLD = 10_000
+
+    def iter_snapshot_clerk_jobs_data(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ):
+        """Memory-bounded transpose for large cohorts (SURVEY hard part
+        #6: the reference's jfs path materializes every ciphertext at
+        once, stores.rs:86-101; its mongo path spills to disk instead).
+
+        Below the threshold: the default single-pass transpose (reads
+        each participation file once). Above it: one pass per clerk,
+        yielding a single clerk's ciphertext column at a time — the
+        snapshot pipeline enqueues each job before the next column is
+        built, so peak memory is one column (1/clerks of the cohort)
+        plus one serialized job, at the cost of ``clerks`` directory
+        scans."""
+        n = self.count_participations_snapshot(aggregation_id, snapshot_id)
+        if n <= self.TRANSPOSE_STREAM_THRESHOLD:
+            return super().iter_snapshot_clerk_jobs_data(
+                aggregation_id, snapshot_id, clerks_number
+            )
+
+        def columns():
+            for ix in range(clerks_number):
+                yield [
+                    p.clerk_encryptions[ix][1]
+                    for p in self.iter_snapped_participations(
+                        aggregation_id, snapshot_id
+                    )
+                ]
+
+        return columns()
+
     def create_snapshot_mask(self, snapshot_id, mask) -> None:
         self.masks.put(snapshot_id, [e.to_json() for e in mask])
 

@@ -50,17 +50,25 @@ def run_snapshot(server, snapshot) -> None:
     if committee is None:
         raise ServerError("lost committee")
 
-    log.debug("snapshot %s: transposing encryptions", snapshot.id)
+    log.debug("snapshot %s: transposing + enqueueing clerking jobs", snapshot.id)
     with metrics.phase("snapshot.transpose"):
-        per_clerk = server.aggregation_store.iter_snapshot_clerk_jobs_data(
-            snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
+        per_clerk = iter(
+            server.aggregation_store.iter_snapshot_clerk_jobs_data(
+                snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
+            )
         )
-
-    log.debug("snapshot %s: enqueueing clerking jobs", snapshot.id)
-    with metrics.phase("snapshot.enqueue"):
-        for ix, ((clerk_id, _), encryptions) in enumerate(
-            zip(committee.clerks_and_keys, per_clerk)
-        ):
+    for ix, (clerk_id, _) in enumerate(committee.clerks_and_keys):
+        # lazy backends (file-store column scans) do their I/O at next();
+        # time it under the transpose phase, not the enqueue phase
+        with metrics.phase("snapshot.transpose"):
+            try:
+                encryptions = next(per_clerk)
+            except StopIteration:
+                raise ServerError(
+                    f"transpose yielded fewer than "
+                    f"{len(committee.clerks_and_keys)} clerk columns"
+                )
+        with metrics.phase("snapshot.enqueue"):
             server.clerking_job_store.enqueue_clerking_job(
                 ClerkingJob(
                     id=_job_id(snapshot.id, ix),
