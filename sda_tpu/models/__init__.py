@@ -40,6 +40,7 @@ from .statistics import (
     SecureStatistics,
     quantiles_from_histogram,
 )
+from .optimizers import FedAdam, FedAvgM, ServerOptimizer
 from .trainer import FederatedTrainer
 
 __all__ = [
@@ -55,8 +56,11 @@ __all__ = [
     "noise_multiplier_for",
     "sample_discrete_gaussian",
     "sample_skellam",
+    "FedAdam",
+    "FedAvgM",
     "FederatedAveraging",
     "FederatedTrainer",
+    "ServerOptimizer",
     "QuantizationSpec",
     "SecureCountDistinct",
     "WeightedFederatedAveraging",

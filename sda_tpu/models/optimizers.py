@@ -1,0 +1,111 @@
+"""Server-side federated optimizers for the multi-round trainer.
+
+Plain FedAvg applies the revealed mean update directly. The standard
+improvements (Reddi et al. 2021, "Adaptive Federated Optimization")
+treat the mean update as a pseudo-gradient and run a server optimizer
+over it: momentum (FedAvgM) and Adam (FedAdam). Both are stateful, so
+they expose ``state()``/``load_state()`` and the trainer persists the
+state inside its round checkpoints — a resumed coordinator continues
+with the same momentum/moment estimates, not a cold restart.
+
+All state lives as flat float64 vectors in the same coordinate layout
+the wire path uses (federated.flatten_pytree), so checkpoints stay
+plain ``.npz`` files.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .federated import flatten_pytree, unflatten_pytree
+
+
+class ServerOptimizer:
+    """Interface: ``apply(global_model, mean_update) -> new model``.
+
+    Optimizers are callables, so a plain function still works wherever
+    a ``ServerOptimizer`` is accepted (the trainer duck-types both).
+    """
+
+    def __call__(self, global_model, mean_update):
+        raise NotImplementedError
+
+    def state(self) -> dict:
+        """numpy-array state for checkpointing (empty when stateless)."""
+        return {}
+
+    def load_state(self, state: dict) -> None:
+        pass
+
+
+class FedAvgM(ServerOptimizer):
+    """Server momentum: ``v = momentum·v + Δ̄;  w += lr·v``."""
+
+    def __init__(self, momentum: float = 0.9, lr: float = 1.0):
+        if not 0.0 <= momentum < 1.0:
+            raise ValueError("momentum must be in [0, 1)")
+        self.momentum = float(momentum)
+        self.lr = float(lr)
+        self._v = None
+
+    def __call__(self, global_model, mean_update):
+        flat_w, treedef, shapes = flatten_pytree(global_model)
+        flat_u, _, _ = flatten_pytree(mean_update)
+        if self._v is None:
+            self._v = np.zeros_like(flat_w)
+        self._v = self.momentum * self._v + flat_u
+        return unflatten_pytree(flat_w + self.lr * self._v, treedef, shapes)
+
+    def state(self) -> dict:
+        return {} if self._v is None else {"v": self._v}
+
+    def load_state(self, state: dict) -> None:
+        if "v" in state:
+            self._v = np.asarray(state["v"], dtype=np.float64)
+
+
+class FedAdam(ServerOptimizer):
+    """Server Adam over the pseudo-gradient Δ̄ (Reddi et al. 2021, Alg. 2).
+
+    ``tau`` is the adaptivity floor (their ε): larger values make the
+    update closer to plain FedAvg scaled by ``lr``.
+    """
+
+    def __init__(self, lr: float = 0.1, beta1: float = 0.9,
+                 beta2: float = 0.99, tau: float = 1e-3):
+        if not (0 <= beta1 < 1 and 0 <= beta2 < 1):
+            raise ValueError("betas must be in [0, 1)")
+        if tau <= 0:
+            raise ValueError("tau must be positive")
+        self.lr, self.beta1, self.beta2, self.tau = (
+            float(lr), float(beta1), float(beta2), float(tau),
+        )
+        self._m = None
+        self._v = None
+        self._t = 0
+
+    def __call__(self, global_model, mean_update):
+        flat_w, treedef, shapes = flatten_pytree(global_model)
+        g, _, _ = flatten_pytree(mean_update)
+        if self._m is None:
+            self._m = np.zeros_like(flat_w)
+            self._v = np.zeros_like(flat_w)
+        self._t += 1
+        self._m = self.beta1 * self._m + (1 - self.beta1) * g
+        self._v = self.beta2 * self._v + (1 - self.beta2) * g * g
+        # bias correction keeps early rounds from undershooting
+        m_hat = self._m / (1 - self.beta1 ** self._t)
+        v_hat = self._v / (1 - self.beta2 ** self._t)
+        step = self.lr * m_hat / (np.sqrt(v_hat) + self.tau)
+        return unflatten_pytree(flat_w + step, treedef, shapes)
+
+    def state(self) -> dict:
+        if self._m is None:
+            return {}
+        return {"m": self._m, "v": self._v, "t": np.int64(self._t)}
+
+    def load_state(self, state: dict) -> None:
+        if "m" in state:
+            self._m = np.asarray(state["m"], dtype=np.float64)
+            self._v = np.asarray(state["v"], dtype=np.float64)
+            self._t = int(state["t"])

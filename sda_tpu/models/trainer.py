@@ -33,8 +33,11 @@ class FederatedTrainer:
     """Iterated secure FedAvg over any ``SdaService``.
 
     ``apply_update`` defaults to plain FedAvg (add the mean update to the
-    global model); pass a custom function for server-side learning rates
-    or momentum. ``checkpoint_dir=None`` disables persistence.
+    global model); pass a ``ServerOptimizer`` (optimizers.FedAvgM /
+    FedAdam) or any callable for server-side learning rates or momentum.
+    Stateful optimizers' state rides inside the checkpoints (``opt_*``
+    keys), so resume continues the momentum/moment estimates.
+    ``checkpoint_dir=None`` disables persistence.
     """
 
     def __init__(
@@ -95,6 +98,17 @@ class FederatedTrainer:
         path = self._ckpt_path()
         fd, tmp = tempfile.mkstemp(dir=self.checkpoint_dir, suffix=".tmp")
         try:
+            state_fn = getattr(self.apply_update, "state", None)
+            opt_state = (
+                {f"opt_{k}": v for k, v in state_fn().items()}
+                if callable(state_fn)
+                else {}
+            )
+            if opt_state:
+                # tag the state with its optimizer class: resuming with a
+                # different optimizer must fail loudly, not install (say)
+                # Adam's second moments as a momentum buffer
+                opt_state["opt_type"] = type(self.apply_update).__name__
             with os.fdopen(fd, "wb") as fh:
                 np.savez(
                     fh,
@@ -104,6 +118,7 @@ class FederatedTrainer:
                     treedef=str(self.fed.treedef),
                     privacy_rhos=np.asarray(self.round_rhos, dtype=np.float64),
                     privacy_delta=self.privacy_delta,
+                    **opt_state,
                 )
             os.replace(tmp, path)
         except BaseException:
@@ -152,6 +167,23 @@ class FederatedTrainer:
             if "privacy_rhos" in data:  # absent in pre-ledger checkpoints
                 self.round_rhos = [float(r) for r in data["privacy_rhos"]]
                 self.privacy_delta = float(data["privacy_delta"])
+            saved_type = (
+                str(data["opt_type"]) if "opt_type" in data.files else None
+            )
+            if saved_type is not None:
+                current = type(self.apply_update).__name__
+                if saved_type != current:
+                    raise ValueError(
+                        f"checkpoint carries {saved_type} optimizer state "
+                        f"but the trainer was built with {current}; resume "
+                        "with the matching optimizer (or delete the "
+                        "checkpoints to restart server optimization cold)"
+                    )
+                self.apply_update.load_state({
+                    k[len("opt_"):]: data[k]
+                    for k in data.files
+                    if k.startswith("opt_") and k != "opt_type"
+                })
         return True
 
     # -- the round loop ------------------------------------------------------

@@ -169,3 +169,90 @@ def test_save_rejects_structural_drift(tmp_path):
     trainer.global_model = {"x": np.zeros(2), "y": np.zeros(2)}  # drifted keys
     with pytest.raises(ValueError, match="structure"):
         trainer.save()
+
+
+def test_server_optimizer_math():
+    """FedAvgM and FedAdam agree with hand-computed updates."""
+    from sda_tpu.models.optimizers import FedAdam, FedAvgM
+
+    model = {"w": np.array([1.0, 2.0])}
+    u1 = {"w": np.array([0.5, -0.5])}
+    u2 = {"w": np.array([0.1, 0.1])}
+
+    m = FedAvgM(momentum=0.5, lr=1.0)
+    step1 = m(model, u1)  # v = u1
+    np.testing.assert_allclose(step1["w"], [1.5, 1.5])
+    step2 = m(step1, u2)  # v = 0.5*u1 + u2
+    np.testing.assert_allclose(step2["w"], step1["w"] + [0.35, -0.15])
+
+    a = FedAdam(lr=0.1, beta1=0.9, beta2=0.99, tau=1e-3)
+    g = np.array([0.5, -0.5])
+    got = a(model, u1)["w"]
+    # first step with bias correction: m_hat = g, v_hat = g^2
+    want = model["w"] + 0.1 * g / (np.abs(g) + 1e-3)
+    np.testing.assert_allclose(got, want)
+    assert set(a.state()) == {"m", "v", "t"}
+
+
+def test_trainer_checkpoints_optimizer_state(tmp_path):
+    """A resumed coordinator continues with the same server-optimizer
+    state (momentum / Adam moments), not a cold restart."""
+    from sda_tpu.models.optimizers import FedAdam
+
+    template = {"w": np.zeros(2), "b": np.zeros(())}
+    spec, sharing = QuantizationSpec.fitted(frac_bits=20, clip=8.0, n_participants=8)
+    fed = FederatedAveraging(spec, template)
+    datasets = [_data(seed) for seed in range(2)]
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        participants = []
+        for i, (x, y) in enumerate(datasets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            participants.append((part, _local_update(x, y)))
+
+        opt = FedAdam(lr=0.5)
+        trainer = FederatedTrainer(
+            fed, template, checkpoint_dir=str(tmp_path / "ckpt"),
+            apply_update=opt,
+        )
+        for _ in range(2):
+            trainer.run_round(recipient, rkey, sharing, participants,
+                              [recipient] + clerks)
+
+        fresh_opt = FedAdam(lr=0.5)
+        resumed = FederatedTrainer(
+            fed, template, checkpoint_dir=str(tmp_path / "ckpt"),
+            apply_update=fresh_opt,
+        )
+        assert resumed.restore_latest()
+        assert resumed.round_index == 2
+        np.testing.assert_array_equal(fresh_opt.state()["m"], opt.state()["m"])
+        np.testing.assert_array_equal(fresh_opt.state()["v"], opt.state()["v"])
+        assert int(fresh_opt.state()["t"]) == 2
+
+        # a mismatched optimizer class must fail loudly, not misload
+        from sda_tpu.models.optimizers import FedAvgM
+
+        mismatched = FederatedTrainer(
+            fed, template, checkpoint_dir=str(tmp_path / "ckpt"),
+            apply_update=FedAvgM(),
+        )
+        with pytest.raises(ValueError, match="FedAdam optimizer state"):
+            mismatched.restore_latest()
+        plain = FederatedTrainer(
+            fed, template, checkpoint_dir=str(tmp_path / "ckpt")
+        )
+        with pytest.raises(ValueError, match="FedAdam optimizer state"):
+            plain.restore_latest()
+
+        # and the resumed trainer can run another round with that state
+        trainer3 = resumed
+        model3 = trainer3.run_round(recipient, rkey, sharing, participants,
+                                    [recipient] + clerks)
+        assert trainer3.round_index == 3
+        from sda_tpu.models import flatten_pytree
+
+        flat, _, _ = flatten_pytree(model3)
+        assert np.isfinite(flat).all()
