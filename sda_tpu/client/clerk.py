@@ -1,10 +1,12 @@
 """Clerk role: poll queue, decrypt, combine, re-encrypt to recipient.
 
 Mirrors /root/reference/client/src/clerk.rs. The hot loop — decrypt every
-participant's share vector and sum mod m — runs as one stacked numpy
-reduction instead of the reference's per-vector accumulate (clerk.rs:71-73
-notes that split wastes memory; the combiner here consumes the whole batch
-at once).
+participant's share vector and sum mod m — runs as stacked numpy
+reductions over fixed-size chunks (DECRYPT_CHUNK participants at a time),
+folding each chunk's partial into a running modular sum: vectorized like
+one big reduction, but peak memory is one chunk of plaintext vectors —
+the accumulating combiner the reference suggests for itself at
+clerk.rs:71-73.
 """
 
 from __future__ import annotations
@@ -16,6 +18,9 @@ from ..utils.metrics import get_metrics
 
 
 class Clerking(VerifiedKeys):
+    #: participants decrypted + folded per block in process_clerking_job;
+    #: bounds clerk memory to one block of plaintext share vectors
+    DECRYPT_CHUNK = 4096
     def clerk_once(self) -> bool:
         """Process the next pending job, if any; returns whether one ran."""
         job = self.service.get_clerking_job(self.agent, self.agent.id)
@@ -57,12 +62,29 @@ class Clerking(VerifiedKeys):
         decryptor = self.crypto.new_share_decryptor(
             own_key_id, aggregation.committee_encryption_scheme
         )
-        with metrics.phase("clerk.decrypt"):
-            share_vectors = decryptor.decrypt_batch(job.encryptions)
-
+        # decrypt + combine in chunks: the reference materializes every
+        # participant's share vector before summing and flags it as a
+        # known inefficiency (clerk.rs:71-73, "accumulating combiner
+        # suggested") — chunking bounds peak memory to one chunk of
+        # plaintext vectors instead of the whole cohort. Chunked partial
+        # sums are congruent mod m to the one-shot combine (signed-
+        # remainder representatives can differ; reconstruction reduces
+        # mod p and the reveal lifts via positive(), so results match).
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
-        with metrics.phase("clerk.combine"):
-            combined = combiner.combine(share_vectors)
+        combined = None
+        for start in range(0, len(job.encryptions), self.DECRYPT_CHUNK):
+            block = job.encryptions[start : start + self.DECRYPT_CHUNK]
+            with metrics.phase("clerk.decrypt"):
+                share_vectors = decryptor.decrypt_batch(block)
+            with metrics.phase("clerk.combine"):
+                partial = combiner.combine(share_vectors)
+                combined = (
+                    partial
+                    if combined is None
+                    else combiner.combine([combined, partial])
+                )
+        if combined is None:  # empty snapshot cut
+            combined = combiner.combine([])
         if isinstance(
             aggregation.recipient_encryption_scheme, PackedPaillierEncryptionScheme
         ):

@@ -218,3 +218,34 @@ def test_participations_racing_snapshot(tmp_path):
         np.testing.assert_array_equal(
             out, (np.array([1, 2, 3, 4]) * n_in_cut) % 433
         )
+
+
+def test_chunked_clerk_combine_exact(tmp_path, monkeypatch):
+    """The clerk's chunked decrypt+combine (memory-bounded accumulation)
+    yields the exact aggregate: chunk size forced to 2 so a 7-participant
+    cohort spans multiple partial folds, across the scheme's signed
+    remainders."""
+    from sda_tpu.client.clerk import Clerking
+
+    monkeypatch.setattr(Clerking, "DECRYPT_CHUNK", 2)
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(4)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _additive_agg(recipient, rkey, share_count=3)
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        for i in range(7):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            p.participate([1, 2, 3, 4], agg.id)
+        recipient.end_aggregation(agg.id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [7, 14, 21, 28])
