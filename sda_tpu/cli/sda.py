@@ -26,6 +26,7 @@ from ..protocol import (
     Aggregation,
     AggregationId,
     Agent,
+    AgentId,
     ChaChaMasking,
     EncryptionKeyId,
     FullMasking,
@@ -89,6 +90,16 @@ def build_parser() -> argparse.ArgumentParser:
     for name in ("begin", "end", "reveal"):
         p = aggs_sub.add_parser(name)
         p.add_argument("aggregation_id")
+        if name == "begin":
+            p.add_argument(
+                "--clerk",
+                action="append",
+                dest="clerks",
+                metavar="AGENT_ID",
+                help="choose this agent as a committee clerk (repeat once "
+                "per clerk, in committee order); default: first suggested "
+                "candidates",
+            )
 
     part = sub.add_parser("participate", help="contribute a vector to an aggregation")
     part.add_argument("id", help="aggregation id")
@@ -230,7 +241,10 @@ def main(argv=None) -> int:
             return 0
         agg_id = AggregationId(args.aggregation_id)
         if args.agg_command == "begin":
-            client.begin_aggregation(agg_id)
+            chosen = (
+                [AgentId(c) for c in args.clerks] if args.clerks else None
+            )
+            client.begin_aggregation(agg_id, chosen_clerks=chosen)
             return 0
         if args.agg_command == "end":
             client.end_aggregation(agg_id)

@@ -32,13 +32,41 @@ class Receiving:
     def upload_aggregation(self, aggregation) -> None:
         self.service.create_aggregation(self.agent, aggregation)
 
-    def begin_aggregation(self, aggregation_id) -> None:
+    def begin_aggregation(self, aggregation_id, *, chosen_clerks=None) -> None:
+        """Elect the committee and open the aggregation for participation.
+
+        Default: the first ``output_size`` suggested candidates — the
+        reference's behavior (receive.rs:48-62). ``chosen_clerks`` (a
+        list of AgentIds) lets the recipient pick its own committee —
+        the reference's README "Doing more" roadmap item ("allow
+        recipient to actually chose the clerks"), delivered here. Order
+        defines committee position; every chosen clerk must be a
+        candidate (i.e. has uploaded a signed encryption key), and the
+        server still independently validates size and key signatures.
+        """
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise ValueError(f"Unknown aggregation {aggregation_id}")
         candidates = self.service.suggest_committee(self.agent, aggregation_id)
         size = aggregation.committee_sharing_scheme.output_size
-        selected = [(c.id, c.keys[0]) for c in candidates[:size]]
+        if chosen_clerks is None:
+            selected = [(c.id, c.keys[0]) for c in candidates[:size]]
+        else:
+            if len(chosen_clerks) != size:
+                raise ValueError(
+                    f"committee needs exactly {size} clerks, "
+                    f"{len(chosen_clerks)} chosen"
+                )
+            if len(set(chosen_clerks)) != len(chosen_clerks):
+                raise ValueError("chosen clerks contain duplicates")
+            by_id = {c.id: c for c in candidates}
+            missing = [str(c) for c in chosen_clerks if c not in by_id]
+            if missing:
+                raise ValueError(
+                    "chosen clerks are not candidates (no signed "
+                    f"encryption key): {', '.join(missing)}"
+                )
+            selected = [(cid, by_id[cid].keys[0]) for cid in chosen_clerks]
         self.service.create_committee(
             self.agent, Committee(aggregation=aggregation_id, clerks_and_keys=selected)
         )

@@ -226,3 +226,60 @@ def test_paillier_rejected_for_chacha_and_committee(tmp_path):
         agg2.committee_encryption_scheme = pscheme
         with pytest.raises(InvalidRequestError, match="recipient encryption only"):
             recipient.upload_aggregation(agg2)
+
+
+def test_recipient_chosen_committee(tmp_path):
+    """The recipient picks its committee explicitly (the reference's
+    'Doing more' roadmap item): chosen clerks in chosen order become the
+    committee, non-candidates and wrong sizes are rejected, and the
+    round reveals the exact sum."""
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(5)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+
+        agg = Aggregation(
+            id=AggregationId.random(), title="chosen", vector_dimension=4,
+            modulus=433, recipient=recipient.agent.id, recipient_key=rkey,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+
+        # validation: wrong size, duplicates, non-candidate
+        with pytest.raises(ValueError, match="exactly 3"):
+            recipient.begin_aggregation(agg.id, chosen_clerks=[clerks[0].agent.id])
+        with pytest.raises(ValueError, match="duplicates"):
+            recipient.begin_aggregation(
+                agg.id,
+                chosen_clerks=[clerks[0].agent.id] * 2 + [clerks[1].agent.id],
+            )
+        with pytest.raises(ValueError, match="not candidates"):
+            recipient.begin_aggregation(
+                agg.id,
+                chosen_clerks=[clerks[0].agent.id, clerks[1].agent.id,
+                               AgentId.random()],
+            )
+
+        # choose clerks 4, 2, 0 in that order
+        chosen = [clerks[4].agent.id, clerks[2].agent.id, clerks[0].agent.id]
+        recipient.begin_aggregation(agg.id, chosen_clerks=chosen)
+        committee = ctx.service.get_committee(recipient.agent, agg.id)
+        assert [c for c, _ in committee.clerks_and_keys] == chosen
+
+        for i in range(2):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            p.participate([1, 2, 3, 4], agg.id)
+        recipient.end_aggregation(agg.id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [2, 4, 6, 8])
