@@ -61,6 +61,23 @@ def build_parser() -> argparse.ArgumentParser:
     keys_sub = keys.add_subparsers(dest="keys_command", required=True)
     keys_sub.add_parser("create")
     keys_sub.add_parser("show")
+    prof = agent_sub.add_parser(
+        "profile", help="public profile (link external identities)"
+    )
+    prof_sub = prof.add_subparsers(dest="profile_command", required=True)
+    pset = prof_sub.add_parser("set")
+    pset.add_argument("--name")
+    pset.add_argument("--twitter")
+    pset.add_argument("--keybase")
+    pset.add_argument("--website")
+    pset.add_argument(
+        "--clear", action="store_true",
+        help="drop fields not given instead of keeping their current values",
+    )
+    pshow = prof_sub.add_parser("show")
+    pshow.add_argument(
+        "owner", nargs="?", help="agent id (default: own profile)"
+    )
 
     clerk = sub.add_parser("clerk", help="run a clerk in a loop")
     clerk.add_argument("-o", "--once", action="store_true", help="Run just once and leave")
@@ -221,6 +238,40 @@ def main(argv=None) -> int:
             if args.keys_command == "show":
                 for key_id in keystore.list_ids():
                     print(key_id)
+                return 0
+        if args.agent_command == "profile":
+            client = SdaClient(require_agent(agent), keystore, service)
+            if args.profile_command == "set":
+                # read-merge-write: flags imply field-level update, so
+                # untouched fields keep their current values (pass
+                # --clear to drop everything not given)
+                existing = (
+                    None if args.clear else client.get_profile(client.agent.id)
+                )
+
+                def merged(flag, field):
+                    if flag is not None:
+                        return flag
+                    return getattr(existing, field) if existing else None
+
+                profile = client.update_profile(
+                    name=merged(args.name, "name"),
+                    twitter_id=merged(args.twitter, "twitter_id"),
+                    keybase_id=merged(args.keybase, "keybase_id"),
+                    website=merged(args.website, "website"),
+                )
+                print(f"Profile updated for {profile.owner}")
+                return 0
+            if args.profile_command == "show":
+                owner = AgentId(args.owner) if args.owner else client.agent.id
+                profile = client.get_profile(owner)
+                if profile is None:
+                    log.warning("No profile for %s", owner)
+                    return 1
+                for field in ("name", "twitter_id", "keybase_id", "website"):
+                    value = getattr(profile, field)
+                    if value is not None:
+                        print(f"{field}: {value}")
                 return 0
 
     if args.command == "clerk":

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from ..protocol import Profile
+
 
 class Maintenance:
     """Upload agent identity and create/upload signed encryption keys."""
@@ -23,3 +25,23 @@ class Maintenance:
         if signed is None:
             raise ValueError("Could not sign encryption key")
         self.service.create_encryption_key(self.agent, signed)
+
+    def update_profile(self, *, name=None, twitter_id=None, keybase_id=None,
+                       website=None):
+        """Create/update the public profile linking this agent to external
+        identities (the reference's trust-building roadmap item: clerk
+        candidates advertising keybase/twitter handles so participants can
+        judge the committee). Only the caller can write its own profile
+        (server ACL). Uploads the FULL object — omitted fields unset
+        (upsert semantics; the CLI layers read-merge-write on top).
+        Returns the stored Profile."""
+        profile = Profile(
+            owner=self.agent.id, name=name, twitter_id=twitter_id,
+            keybase_id=keybase_id, website=website,
+        )
+        self.service.upsert_profile(self.agent, profile)
+        return profile
+
+    def get_profile(self, owner_id):
+        """Fetch any agent's public profile (None when unset)."""
+        return self.service.get_profile(self.agent, owner_id)

@@ -3,7 +3,13 @@ runnable against every backend via the fixture matrix."""
 
 import pytest
 
-from sda_fixtures import new_agent, new_full_agent, new_key_for_agent, with_service
+from sda_fixtures import (
+    new_agent,
+    new_client,
+    new_full_agent,
+    new_key_for_agent,
+    with_service,
+)
 from sda_tpu.protocol import (
     AdditiveSharing,
     Aggregation,
@@ -120,3 +126,28 @@ def test_aggregation_crud():
         ctx.service.delete_aggregation(alice, agg.id)
         assert ctx.service.get_aggregation(alice, agg.id) is None
         assert ctx.service.list_aggregations(alice, None, None) == []
+
+
+def test_client_profile_roundtrip(tmp_path):
+    """Client-level profile linking (reference roadmap: candidates link
+    external identities): update own profile, read any agent's, and the
+    server ACL still rejects writing someone else's."""
+    with with_service() as ctx:
+        alice = new_client(tmp_path / "alice", ctx.service)
+        alice.upload_agent()
+        bob = new_client(tmp_path / "bob", ctx.service)
+        bob.upload_agent()
+
+        assert alice.get_profile(alice.agent.id) is None
+        alice.update_profile(name="alice", keybase_id="al")
+        seen_by_bob = bob.get_profile(alice.agent.id)
+        assert seen_by_bob.name == "alice" and seen_by_bob.keybase_id == "al"
+
+        # overwrite keeps only the new fields (upsert of the full object)
+        alice.update_profile(website="https://a.example")
+        assert bob.get_profile(alice.agent.id).name is None
+
+        with pytest.raises(PermissionDeniedError):
+            ctx.service.upsert_profile(
+                bob.agent, Profile(owner=alice.agent.id, name="evil")
+            )
