@@ -171,6 +171,13 @@ def main() -> int:
         "reporting and budget checks (same compiled fn each time)",
     )
     parser.add_argument(
+        "--trace-dir",
+        default=None,
+        metavar="DIR",
+        help="capture a JAX profiler trace of the steady-state segments "
+        "into DIR (view with xprof/tensorboard)",
+    )
+    parser.add_argument(
         "--deadline",
         type=float,
         default=None,
@@ -438,25 +445,39 @@ def main() -> int:
     done_segments = 1
     steady_elems = 0
     steady_s = 0.0
-    for _ in range(1, n_segments):
-        if time.perf_counter() - bench_t0 > args.budget:
+    trace = contextlib.nullcontext()
+    if args.trace_dir:
+        if n_segments > 1:
+            trace = jax.profiler.trace(args.trace_dir)
+            print(f"[bench] tracing steady segments into {args.trace_dir}",
+                  file=sys.stderr)
+        else:
             print(
-                f"[bench] budget {args.budget:.0f}s spent after "
-                f"{done_segments}/{n_segments} segments; stopping early",
+                "[bench] --trace-dir ignored: only one segment (the trace "
+                "covers steady-state segments 2+; raise --segments or the "
+                "workload)",
                 file=sys.stderr,
             )
-            break
-        t0 = time.perf_counter()
-        acc, plain, key = run_seg(acc, plain, key)
-        np.asarray(plain)
-        dt = time.perf_counter() - t0
-        steady_s += dt
-        steady_elems += seg_chunks * chunk * dim
-        done_segments += 1
-        print(
-            f"[bench] segment {done_segments}/{n_segments}: {dt:.2f}s",
-            file=sys.stderr,
-        )
+    with trace:
+        for _ in range(1, n_segments):
+            if time.perf_counter() - bench_t0 > args.budget:
+                print(
+                    f"[bench] budget {args.budget:.0f}s spent after "
+                    f"{done_segments}/{n_segments} segments; stopping early",
+                    file=sys.stderr,
+                )
+                break
+            t0 = time.perf_counter()
+            acc, plain, key = run_seg(acc, plain, key)
+            np.asarray(plain)
+            dt = time.perf_counter() - t0
+            steady_s += dt
+            steady_elems += seg_chunks * chunk * dim
+            done_segments += 1
+            print(
+                f"[bench] segment {done_segments}/{n_segments}: {dt:.2f}s",
+                file=sys.stderr,
+            )
 
     # reconstruct + verify (any t+k of n clerks; drop one for the dropout path)
     with stage("reconstruct + verify"):
