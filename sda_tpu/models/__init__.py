@@ -34,6 +34,7 @@ from .federated import (
 )
 from .statistics import (
     SecureCountDistinct,
+    SecureCovariance,
     SecureFrequency,
     SecureHistogram,
     SecureQuantiles,
@@ -63,6 +64,7 @@ __all__ = [
     "ServerOptimizer",
     "QuantizationSpec",
     "SecureCountDistinct",
+    "SecureCovariance",
     "WeightedFederatedAveraging",
     "SecureFrequency",
     "SecureHistogram",

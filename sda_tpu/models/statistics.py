@@ -26,6 +26,16 @@ import numpy as np
 from .federated import FederatedAveraging, QuantizationSpec
 
 
+def _validate_vector(values, dim: int, clip: float) -> np.ndarray:
+    """Shared submission check: shape ``(dim,)``, |coordinate| ≤ clip."""
+    values = np.asarray(values, dtype=np.float64)
+    if values.shape != (dim,):
+        raise ValueError(f"expected ({dim},) values, got {values.shape}")
+    if np.abs(values).max(initial=0.0) > clip:
+        raise ValueError(f"values exceed clip bound {clip}")
+    return values
+
+
 class SecureStatistics:
     """Cohort mean + variance of ``(dim,)`` float vectors, privately.
 
@@ -50,11 +60,7 @@ class SecureStatistics:
 
     def _checked_tree(self, values) -> dict:
         """Validate one submission and build its ``[x, x²]`` channel."""
-        values = np.asarray(values, dtype=np.float64)
-        if values.shape != (self.dim,):
-            raise ValueError(f"expected ({self.dim},) values, got {values.shape}")
-        if np.abs(values).max(initial=0.0) > self.clip:
-            raise ValueError(f"values exceed clip bound {self.clip}")
+        values = _validate_vector(values, self.dim, self.clip)
         return {"sum": values, "sumsq": values * values}
 
     def submit(self, participant, aggregation_id, values) -> None:
@@ -71,6 +77,90 @@ class SecureStatistics:
         mean = means["sum"]
         variance = np.maximum(means["sumsq"] - mean * mean, 0.0)
         return {"count": n_submitted, "mean": mean, "variance": variance}
+
+
+class SecureCovariance:
+    """Cohort covariance (and correlation) of ``(dim,)`` vectors, privately.
+
+    Each participant submits ``[x, vech(x xᵀ)]`` — its vector plus the
+    upper triangle of its outer product (``d(d+1)/2`` extra
+    coordinates). The revealed sums give ``E[x]`` and ``E[x xᵀ]``, hence
+    ``Cov = E[x xᵀ] − E[x]E[x]ᵀ`` — the population covariance across
+    participants, exact in the field up to quantization. The covariance
+    matrix is the input to federated PCA / correlation analysis; no
+    party ever sees an individual's vector.
+
+    ``clip`` bounds each |coordinate|, so products are bounded by
+    ``clip²`` and the field is fitted to ``max(clip, clip²)`` — the same
+    discipline as ``SecureStatistics``.
+    """
+
+    def __init__(self, dim: int, clip: float, n_participants: int,
+                 frac_bits: int = 16):
+        if dim < 1:
+            raise ValueError("dim must be >= 1")
+        self.dim = dim
+        self.clip = float(clip)
+        bound = max(clip, clip * clip)
+        self.spec, self.sharing = QuantizationSpec.fitted(
+            frac_bits, bound, n_participants
+        )
+        self._triu = np.triu_indices(dim)
+        template = {
+            "sum": np.zeros(dim),
+            "outer": np.zeros(dim * (dim + 1) // 2),
+        }
+        self.fed = FederatedAveraging(self.spec, template)
+
+    def open_round(self, recipient, recipient_key):
+        return self.fed.open_round(
+            recipient, recipient_key, self.sharing, title="secure-covariance"
+        )
+
+    def submit(self, participant, aggregation_id, values) -> None:
+        values = _validate_vector(values, self.dim, self.clip)
+        outer = np.outer(values, values)[self._triu]
+        self.fed.submit_update(
+            participant, aggregation_id, {"sum": values, "outer": outer}
+        )
+
+    def close_round(self, recipient, aggregation_id) -> None:
+        self.fed.close_round(recipient, aggregation_id)
+
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """-> {"count", "mean", "covariance"} (population covariance,
+        PSD up to quantization error)."""
+        means = self.fed.finish_round(recipient, aggregation_id, n_submitted)
+        mean = means["sum"]
+        m2 = np.zeros((self.dim, self.dim))
+        m2[self._triu] = means["outer"]
+        m2 = m2 + m2.T - np.diag(np.diag(m2))  # mirror the upper triangle
+        cov = m2 - np.outer(mean, mean)
+        # quantization can push a near-constant coordinate's variance a
+        # hair negative; clamp so sqrt(diag) downstream stays finite
+        np.fill_diagonal(cov, np.maximum(np.diag(cov), 0.0))
+        return {"count": n_submitted, "mean": mean, "covariance": cov}
+
+    @staticmethod
+    def correlation_from_covariance(cov: np.ndarray) -> np.ndarray:
+        """Correlation matrix; zero-variance coordinates yield zero
+        off-diagonals and a unit diagonal."""
+        std = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        denom = np.outer(std, std)
+        corr = np.divide(
+            cov, denom, out=np.zeros_like(np.asarray(cov, dtype=np.float64)),
+            where=denom > 0,
+        )
+        np.fill_diagonal(corr, 1.0)
+        return np.clip(corr, -1.0, 1.0)
+
+    def finish_correlation(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """Like ``finish`` plus the correlation matrix."""
+        result = self.finish(recipient, aggregation_id, n_submitted)
+        result["correlation"] = self.correlation_from_covariance(
+            result["covariance"]
+        )
+        return result
 
 
 class SecureHistogram:

@@ -329,3 +329,60 @@ def test_count_distinct_round(tmp_path):
     np.testing.assert_array_equal(counts, want)  # protocol is exact
     est = cd.estimate_from_counts(counts)
     assert abs(est - true_distinct) / true_distinct < 0.15
+
+
+# --- covariance -------------------------------------------------------------
+
+
+def test_secure_covariance_round(tmp_path):
+    """Cohort covariance + correlation through the full protocol match
+    numpy's population covariance of the stacked vectors."""
+    from sda_tpu.models.statistics import SecureCovariance
+
+    dim, n = 5, 6
+    sc = SecureCovariance(dim=dim, clip=3.0, n_participants=8, frac_bits=18)
+    rng = np.random.default_rng(12)
+    base = rng.uniform(-1.5, 1.5, size=(n, 2))
+    # correlated structure: coords are linear mixes of two factors
+    mix = rng.uniform(-1.0, 1.0, size=(2, dim))
+    data = base @ mix + 0.05 * rng.normal(size=(n, dim))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = sc.open_round(recipient, rkey)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            sc.submit(part, agg_id, data[i])
+        sc.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = sc.finish_correlation(recipient, agg_id, n)
+
+    want_cov = np.cov(data, rowvar=False, bias=True)
+    tol = 40 * n / sc.spec.scale  # quantization of the product channel
+    np.testing.assert_allclose(result["mean"], data.mean(axis=0), atol=tol)
+    np.testing.assert_allclose(result["covariance"], want_cov, atol=tol)
+    want_corr = np.corrcoef(data, rowvar=False)
+    np.testing.assert_allclose(result["correlation"], want_corr, atol=0.01)
+    np.testing.assert_allclose(np.diag(result["correlation"]), 1.0)
+    # symmetry is exact by construction
+    np.testing.assert_array_equal(result["covariance"], result["covariance"].T)
+
+
+def test_secure_covariance_validation_and_degenerate():
+    from sda_tpu.models.statistics import SecureCovariance
+
+    sc = SecureCovariance(dim=3, clip=1.0, n_participants=2)
+    with pytest.raises(ValueError, match="clip bound"):
+        sc.submit(object(), object(), np.array([0.0, 2.0, 0.0]))
+    with pytest.raises(ValueError, match="expected"):
+        sc.submit(object(), object(), np.zeros(4))
+    # zero-variance coordinate through the REAL code path: correlation
+    # stays finite (0 off-diag, 1 diag), even with a slightly negative
+    # quantization-artifact variance
+    cov = np.array([[-1e-9, 0.3], [0.3, 2.0]])
+    corr = SecureCovariance.correlation_from_covariance(cov)
+    assert np.isfinite(corr).all()
+    np.testing.assert_allclose(np.diag(corr), 1.0)
+    assert corr[0, 1] == 0.0 and corr[1, 0] == 0.0
