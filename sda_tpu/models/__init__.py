@@ -15,6 +15,7 @@ from .dp import (
     compose_rhos,
     DPConfig,
     DPFederatedAveraging,
+    DPSecureCovariance,
     DPSecureHistogram,
     DPSecureStatistics,
     PrivacyAccount,
@@ -50,6 +51,7 @@ __all__ = [
     "compose_rhos",
     "DPConfig",
     "DPFederatedAveraging",
+    "DPSecureCovariance",
     "DPSecureHistogram",
     "DPSecureStatistics",
     "PrivacyAccount",

@@ -53,7 +53,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .federated import FederatedAveraging, QuantizationSpec
-from .statistics import SecureHistogram, SecureStatistics
+from .statistics import SecureCovariance, SecureHistogram, SecureStatistics
 
 # Field headroom reserved for aggregate noise, in units of sigma_total.
 # Sub-Gaussian tail: P(|noise| > k*sigma) <= 2*exp(-k^2/2) ~ 5e-32 at 12.
@@ -455,6 +455,56 @@ class DPSecureStatistics(SecureStatistics):
             frac_bits, self.dp, 2 * dim
         )
         template = {"sum": np.zeros(dim), "sumsq": np.zeros(dim)}
+        self.fed = DPFederatedAveraging(self.spec, template, self.dp, rng=rng)
+
+    def submit(self, participant, aggregation_id, values, *, rng=None) -> None:
+        self.fed.submit_update(
+            participant, aggregation_id, self._checked_tree(values), rng=rng
+        )
+
+    def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
+        return self.fed.privacy(n_actual)
+
+
+class DPSecureCovariance(SecureCovariance):
+    """Cohort covariance/correlation under distributed DP.
+
+    ``SecureCovariance`` (participants submit ``[x, vech(x xᵀ)]``) over
+    a ``DPFederatedAveraging`` round. For per-coordinate ``|x| ≤ c`` the
+    channel's L2 bound is ``sqrt(d·c² + d(d+1)/2·c⁴)``
+    (``||vech(xxᵀ)||₂² = Σ_{i≤j}(x_i x_j)² ≤ d(d+1)/2·c⁴``, each
+    off-diagonal product counted once) — the DP clip, tight at
+    x = (c,…,c), so in-bounds submissions are never rescaled. The noisy
+    covariance is symmetric by construction but only approximately PSD;
+    its diagonal still clamps at 0 (parent ``finish``).
+    """
+
+    def __init__(self, dim: int, clip: float, n_participants: int, *,
+                 noise_multiplier: float, delta: float = 1e-6,
+                 frac_bits: int = 16, mechanism: str = "dgauss", rng=None):
+        if dim < 1:
+            raise ValueError("dim must be >= 1")
+        if clip <= 0:
+            raise ValueError("clip must be positive")
+        self.dim = dim
+        self.clip = float(clip)
+        self._triu = np.triu_indices(dim)
+        wire = dim + dim * (dim + 1) // 2
+        l2 = math.sqrt(
+            dim * clip * clip + dim * (dim + 1) / 2.0 * clip ** 4
+        )
+        self.dp = DPConfig(
+            l2_clip=l2, noise_multiplier=noise_multiplier,
+            expected_participants=n_participants, delta=delta,
+            mechanism=mechanism,
+        )
+        self.spec, self.sharing = DPFederatedAveraging.fitted_spec(
+            frac_bits, self.dp, wire
+        )
+        template = {
+            "sum": np.zeros(dim),
+            "outer": np.zeros(dim * (dim + 1) // 2),
+        }
         self.fed = DPFederatedAveraging(self.spec, template, self.dp, rng=rng)
 
     def submit(self, participant, aggregation_id, values, *, rng=None) -> None:

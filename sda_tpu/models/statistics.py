@@ -117,11 +117,14 @@ class SecureCovariance:
             recipient, recipient_key, self.sharing, title="secure-covariance"
         )
 
-    def submit(self, participant, aggregation_id, values) -> None:
+    def _checked_tree(self, values) -> dict:
+        """Validate one submission and build its ``[x, vech(x xᵀ)]`` channel."""
         values = _validate_vector(values, self.dim, self.clip)
-        outer = np.outer(values, values)[self._triu]
+        return {"sum": values, "outer": np.outer(values, values)[self._triu]}
+
+    def submit(self, participant, aggregation_id, values) -> None:
         self.fed.submit_update(
-            participant, aggregation_id, {"sum": values, "outer": outer}
+            participant, aggregation_id, self._checked_tree(values)
         )
 
     def close_round(self, recipient, aggregation_id) -> None:
@@ -161,6 +164,32 @@ class SecureCovariance:
             result["covariance"]
         )
         return result
+
+    @staticmethod
+    def principal_components(cov: np.ndarray, k: int):
+        """Top-``k`` eigenpairs of a (revealed) covariance matrix —
+        federated PCA is exactly this post-processing: the only
+        cross-party computation was the secure covariance itself.
+
+        Returns ``(eigenvalues, components)``: eigenvalues descending
+        (clamped at 0 — a noisy/quantized matrix can dip negative),
+        components as ``(k, dim)`` rows, deterministically signed (the
+        largest-|coordinate| entry of each component is positive).
+        """
+        cov = np.asarray(cov, dtype=np.float64)
+        if cov.ndim != 2 or cov.shape[0] != cov.shape[1]:
+            raise ValueError("covariance must be square")
+        if not 1 <= k <= cov.shape[0]:
+            raise ValueError(f"k must be in [1, {cov.shape[0]}]")
+        eigvals, eigvecs = np.linalg.eigh((cov + cov.T) / 2.0)
+        order = np.argsort(eigvals)[::-1][:k]
+        values = np.maximum(eigvals[order], 0.0)
+        components = eigvecs[:, order].T
+        for row in components:  # deterministic sign convention
+            pivot = np.argmax(np.abs(row))
+            if row[pivot] < 0:
+                row *= -1.0
+        return values, components
 
 
 class SecureHistogram:

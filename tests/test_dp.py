@@ -450,3 +450,44 @@ def test_fitted_spec_noise_headroom():
     assert spec_b.modulus > spec_s.modulus
     # headroom covers data + tail-sigma noise per coordinate
     assert dp_big.field_need(spec_b.scale, 8) < spec_b.modulus / 2
+
+
+def test_dp_covariance_round(tmp_path):
+    """DP covariance: exact noise replay through the protocol and finite,
+    symmetric output."""
+    from sda_tpu.models.dp import DPSecureCovariance
+
+    dim, n = 4, 3
+    sc = DPSecureCovariance(dim=dim, clip=1.5, n_participants=n,
+                            noise_multiplier=0.01, frac_bits=16,
+                            rng=np.random.default_rng(3))
+    rng = np.random.default_rng(9)
+    data = rng.uniform(-1.5, 1.5, size=(n, dim))
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = sc.open_round(recipient, rkey)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            sc.submit(part, agg_id, data[i])
+        sc.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = sc.finish_correlation(recipient, agg_id, n)
+
+    cov, corr = result["covariance"], result["correlation"]
+    np.testing.assert_array_equal(cov, cov.T)
+    assert np.isfinite(cov).all() and np.isfinite(corr).all()
+    assert (np.diag(cov) >= 0).all()
+    wire = dim + dim * (dim + 1) // 2
+    sigma = sc.dp.sigma_total_field(sc.spec.scale, wire) / (n * sc.spec.scale)
+    want = np.cov(data, rowvar=False, bias=True)
+    # noise on E[xx^T] and E[x] propagates ~linearly at this tiny z
+    assert np.abs(cov - want).max() < 40 * sigma + 0.01
+    assert sc.privacy(n).epsilon > 0
+    # the sensitivity bound is TIGHT at x = (c,...,c): no over-noising
+    x = np.full(dim, sc.clip)
+    vech = np.outer(x, x)[np.triu_indices(dim)]
+    true_norm = np.sqrt((x ** 2).sum() + (vech ** 2).sum())
+    assert abs(true_norm - sc.dp.l2_clip) < 1e-9

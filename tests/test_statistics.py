@@ -386,3 +386,26 @@ def test_secure_covariance_validation_and_degenerate():
     assert np.isfinite(corr).all()
     np.testing.assert_allclose(np.diag(corr), 1.0)
     assert corr[0, 1] == 0.0 and corr[1, 0] == 0.0
+
+
+def test_principal_components():
+    from sda_tpu.models.statistics import SecureCovariance
+
+    # planted spectrum: eigenvalues 5 and 1 along known directions
+    theta = 0.3
+    r = np.array([[np.cos(theta), -np.sin(theta)],
+                  [np.sin(theta), np.cos(theta)]])
+    cov = r @ np.diag([5.0, 1.0]) @ r.T
+    values, comps = SecureCovariance.principal_components(cov, 2)
+    np.testing.assert_allclose(values, [5.0, 1.0], atol=1e-12)
+    np.testing.assert_allclose(np.abs(comps[0] @ r[:, 0]), 1.0, atol=1e-12)
+    # deterministic sign: the largest-|coordinate| entry is positive
+    for row in comps:
+        assert row[np.argmax(np.abs(row))] > 0
+    # negative eigenvalues clamp at zero (noisy matrices)
+    vals, _ = SecureCovariance.principal_components(np.diag([1.0, -0.5]), 2)
+    np.testing.assert_array_equal(vals, [1.0, 0.0])
+    with pytest.raises(ValueError, match="square"):
+        SecureCovariance.principal_components(np.zeros((2, 3)), 1)
+    with pytest.raises(ValueError, match="k must"):
+        SecureCovariance.principal_components(np.eye(2), 3)
