@@ -104,6 +104,28 @@ def main():
     print("DP latency histogram:       ", np.round(noisy, 1).tolist())
     print(f"DP guarantee: eps={acct.epsilon:.2f} delta={acct.delta:g} "
           f"(noise std ~{acct.sigma_total / dph.spec.scale:.0f} counts/bin)")
+
+    # --- query 5: cross-endpoint covariance + leading principal component
+    # (federated PCA): which endpoints' latencies move together?
+    from sda_tpu.models import SecureCovariance
+
+    sc = SecureCovariance(dim=8, clip=8.0, n_participants=8, frac_bits=18)
+    agg = sc.open_round(recipient, rkey)
+    for org, means, _ in orgs:
+        sc.submit(org, agg, means)
+    sc.close_round(recipient, agg)
+    for w in [recipient] + clerks:
+        w.run_chores(-1)
+    result = sc.finish_correlation(recipient, agg, len(orgs))
+    evals, comps = SecureCovariance.principal_components(result["covariance"], 1)
+    i, j = np.unravel_index(
+        np.abs(np.triu(result["correlation"], 1)).argmax(),
+        result["correlation"].shape
+    )
+    print(f"top correlation pair:        endpoints {int(i)} and {int(j)} "
+          f"(r={result['correlation'][i, j]:.2f})")
+    print(f"PC1 explains {evals[0] / max(np.trace(result['covariance']), 1e-12):.0%} "
+          f"of cohort latency variance; direction={np.round(comps[0], 2)}")
     return 0
 
 
