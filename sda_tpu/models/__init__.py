@@ -42,6 +42,7 @@ from .statistics import (
     SecureStatistics,
     quantiles_from_histogram,
 )
+from .evaluation import SecureEvaluation
 from .optimizers import FedAdam, FedAvgM, ServerOptimizer
 from .trainer import FederatedTrainer
 
@@ -67,6 +68,7 @@ __all__ = [
     "QuantizationSpec",
     "SecureCountDistinct",
     "SecureCovariance",
+    "SecureEvaluation",
     "WeightedFederatedAveraging",
     "SecureFrequency",
     "SecureHistogram",

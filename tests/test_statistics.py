@@ -409,3 +409,61 @@ def test_principal_components():
         SecureCovariance.principal_components(np.zeros((2, 3)), 1)
     with pytest.raises(ValueError, match="k must"):
         SecureCovariance.principal_components(np.eye(2), 3)
+
+
+# --- evaluation -------------------------------------------------------------
+
+
+def test_secure_evaluation_round(tmp_path):
+    """Example-weighted cohort metrics through the full protocol: sites
+    with 10/40/950 examples produce the exact weighted means."""
+    from sda_tpu.models.evaluation import SecureEvaluation
+
+    ev = SecureEvaluation(["loss", "accuracy"], n_participants=4,
+                          bound=10.0, max_examples=1000, frac_bits=18)
+    sites = [
+        ({"loss": 0.8, "accuracy": 0.5}, 10),
+        ({"loss": 0.4, "accuracy": 0.9}, 40),
+        ({"loss": 0.2, "accuracy": 0.95}, 950),
+    ]
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = ev.open_round(recipient, rkey)
+        for i, (metrics, n) in enumerate(sites):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            ev.submit(part, agg_id, metrics, n)
+        ev.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = ev.finish(recipient, agg_id, len(sites))
+
+    total = sum(n for _, n in sites)
+    assert result["examples"] == total
+    for name in ("loss", "accuracy"):
+        want = sum(m[name] * n for m, n in sites) / total
+        assert abs(result[name] - want) < 1e-3
+
+
+def test_secure_evaluation_validation():
+    from sda_tpu.models.evaluation import SecureEvaluation
+
+    ev = SecureEvaluation(["loss"], n_participants=2, max_examples=100)
+    with pytest.raises(ValueError, match="missing metrics"):
+        ev.submit(object(), object(), {"acc": 1.0}, 5)
+    with pytest.raises(ValueError, match="n_examples"):
+        ev.submit(object(), object(), {"loss": 1.0}, 0)
+    with pytest.raises(ValueError, match="weight"):
+        ev.submit(object(), object(), {"loss": 1.0}, 101)
+    with pytest.raises(ValueError, match="at least one"):
+        SecureEvaluation([], n_participants=2)
+
+
+def test_secure_evaluation_reserved_and_duplicate_names():
+    from sda_tpu.models.evaluation import SecureEvaluation
+
+    with pytest.raises(ValueError, match="reserved"):
+        SecureEvaluation(["examples", "loss"], n_participants=2)
+    with pytest.raises(ValueError, match="duplicate"):
+        SecureEvaluation(["loss", "loss"], n_participants=2)
