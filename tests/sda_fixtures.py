@@ -104,3 +104,18 @@ def new_client(tmpdir, service) -> SdaClient:
     keystore = Keystore(tmpdir)
     agent = SdaClient.new_agent(keystore)
     return SdaClient(agent, keystore, service)
+
+
+def new_committee_setup(tmp_path, service, n_clerks: int = 8):
+    """Recipient (with uploaded encryption key) + ``n_clerks`` keyed
+    clerks — the standard cohort scaffold for model-layer tests.
+    Returns (recipient, recipient_key_id, clerks)."""
+    recipient = new_client(tmp_path / "r", service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client(tmp_path / f"c{i}", service) for i in range(n_clerks)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    return recipient, rkey, clerks

@@ -135,3 +135,87 @@ def test_degenerate_shapes(dim, n_participants, tmp_path):
     single-participant aggregations."""
     _random_round(200 + dim * 7 + n_participants, tmp_path, dim=dim,
                   n_participants=n_participants)
+
+
+@pytest.mark.parametrize("driver", ["weighted", "covariance", "evaluation"])
+@pytest.mark.parametrize("seed", range(2))
+def test_random_model_layer_round(driver, seed, tmp_path):
+    """Randomized sweep over the model-layer drivers (weighted FedAvg,
+    covariance, evaluation): random shapes/cohorts through the full
+    protocol must match the plaintext computation to quantization
+    accuracy. Deterministic seeds."""
+    from sda_tpu.models import (
+        SecureCovariance,
+        SecureEvaluation,
+        WeightedFederatedAveraging,
+    )
+
+    drivers = ["weighted", "covariance", "evaluation"]
+    rng = np.random.default_rng(1000 + seed * 31 + drivers.index(driver))
+    n = int(rng.integers(2, 5))
+    dim = int(rng.integers(1, 9))
+
+    with with_service() as ctx:
+        from sda_fixtures import new_committee_setup
+
+        recipient, rkey, clerks = new_committee_setup(tmp_path, ctx.service)
+        parts = []
+        for i in range(n):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            parts.append(p)
+
+        if driver == "weighted":
+            fed, sharing = WeightedFederatedAveraging.fitted(
+                frac_bits=16, clip=2.0, max_weight=50.0, n_participants=n,
+                template_tree={"w": np.zeros(dim)},
+            )
+            data = rng.uniform(-2, 2, size=(n, dim))
+            weights = rng.integers(1, 50, size=n).astype(np.float64)
+            agg = fed.open_round(recipient, rkey, sharing)
+            for p, x, w in zip(parts, data, weights):
+                fed.submit_update(p, agg, {"w": x}, weight=float(w))
+            fed.close_round(recipient, agg)
+            for c in [recipient] + clerks:
+                c.run_chores(-1)
+            mean, total = fed.finish_round(recipient, agg, n)
+            np.testing.assert_allclose(
+                mean["w"], np.average(data, axis=0, weights=weights),
+                atol=n * 50.0 / (1 << 16) * 4,
+            )
+            assert abs(total - weights.sum()) < 1e-3
+        elif driver == "covariance":
+            sc = SecureCovariance(dim=dim, clip=2.0, n_participants=n,
+                                  frac_bits=16)
+            data = rng.uniform(-2, 2, size=(n, dim))
+            agg = sc.open_round(recipient, rkey)
+            for p, x in zip(parts, data):
+                sc.submit(p, agg, x)
+            sc.close_round(recipient, agg)
+            for c in [recipient] + clerks:
+                c.run_chores(-1)
+            result = sc.finish(recipient, agg, n)
+            np.testing.assert_allclose(
+                result["covariance"], np.cov(data, rowvar=False, bias=True),
+                atol=50 * n / (1 << 16),
+            )
+        else:
+            ev = SecureEvaluation(["m0", "m1"], n_participants=n,
+                                  bound=5.0, max_examples=100)
+            sites = [
+                ({"m0": float(rng.uniform(0, 5)), "m1": float(rng.uniform(0, 1))},
+                 int(rng.integers(1, 100)))
+                for _ in range(n)
+            ]
+            agg = ev.open_round(recipient, rkey)
+            for p, (m, cnt) in zip(parts, sites):
+                ev.submit(p, agg, m, cnt)
+            ev.close_round(recipient, agg)
+            for c in [recipient] + clerks:
+                c.run_chores(-1)
+            result = ev.finish(recipient, agg, n)
+            total = sum(cnt for _, cnt in sites)
+            assert result["examples"] == total
+            for name in ("m0", "m1"):
+                want = sum(m[name] * cnt for m, cnt in sites) / total
+                assert abs(result[name] - want) < 1e-2
