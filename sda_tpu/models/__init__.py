@@ -18,6 +18,7 @@ from .dp import (
     DPSecureCovariance,
     DPSecureHistogram,
     DPSecureStatistics,
+    DPWeightedFederatedAveraging,
     PrivacyAccount,
     eps_from_zcdp,
     noise_multiplier_for,
@@ -55,6 +56,7 @@ __all__ = [
     "DPSecureCovariance",
     "DPSecureHistogram",
     "DPSecureStatistics",
+    "DPWeightedFederatedAveraging",
     "PrivacyAccount",
     "eps_from_zcdp",
     "noise_multiplier_for",

@@ -52,7 +52,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .federated import FederatedAveraging, QuantizationSpec
+from .federated import (
+    FederatedAveraging,
+    QuantizationSpec,
+    WeightedFederatedAveraging,
+)
 from .statistics import SecureCovariance, SecureHistogram, SecureStatistics
 
 # Field headroom reserved for aggregate noise, in units of sigma_total.
@@ -288,13 +292,21 @@ class DPConfig:
             self.expected_participants
         )
 
-    def field_need(self, scale: int, dim: int) -> float:
+    def field_need(self, scale: int, dim: int,
+                   per_coordinate_bound: float | None = None) -> float:
         """Per-coordinate magnitude the field must hold without wrapping:
         the data sum plus the NOISE_TAIL_SIGMAS aggregate-noise margin.
-        Single source of truth for builder (``fitted_spec``), the
-        construction-time guard, and the tests."""
+        Single source of truth for builders (``fitted_spec`` /
+        ``fitted_dp``), the construction-time guards, and the tests.
+
+        ``per_coordinate_bound`` defaults to ``l2_clip`` (a valid, if
+        conservative, coordinate bound); channels with a tighter known
+        per-coordinate bound (e.g. the weighted channel's
+        ``clip·max_weight``) pass it to avoid a ~sqrt(d)-oversized field.
+        """
+        bound = self.l2_clip if per_coordinate_bound is None else per_coordinate_bound
         return (
-            self.expected_participants * scale * self.l2_clip
+            self.expected_participants * scale * bound
             + NOISE_TAIL_SIGMAS * self.sigma_total_field(scale, dim)
         )
 
@@ -353,7 +365,54 @@ def l2_clip_vector(flat: np.ndarray, clip: float) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-class DPFederatedAveraging(FederatedAveraging):
+class _DPRoundMixin:
+    """Shared DP-round plumbing for drivers over a (possibly widened)
+    field vector: the per-party sigma feasibility + noise-headroom
+    guards, the revealed-cohort memo, and realized-privacy accounting.
+    Hosts must set ``self.spec``/``self.dp`` before calling
+    ``_check_dp_feasible`` and expose ``wire_dimension``.
+    """
+
+    def _check_dp_feasible(self, per_coordinate_bound: float | None = None,
+                           builder: str = ".fitted_spec") -> None:
+        sigma = self.dp.sigma_party_field(self.spec.scale, self.wire_dimension)
+        if sigma < self.dp.min_party_sigma:
+            raise ValueError(
+                f"per-party sigma {sigma:.3f} < min_party_sigma "
+                f"{self.dp.min_party_sigma}; raise noise_multiplier or "
+                "frac_bits"
+            )
+        # a data-only-fitted field accepts the data sum but wraps under
+        # aggregate noise — require the NOISE_TAIL_SIGMAS margin the
+        # mechanism was accounted with
+        need = self.dp.field_need(
+            self.spec.scale, self.wire_dimension, per_coordinate_bound
+        )
+        if not need < (self.spec.modulus - 1) // 2:
+            raise ValueError(
+                f"field {self.spec.modulus} lacks noise headroom: data + "
+                f"{NOISE_TAIL_SIGMAS:g}sigma needs > {int(2 * need) + 1}; "
+                f"build the spec with {builder}"
+            )
+
+    def reveal_field_sum(self, recipient, aggregation_id, n_submitted: int):
+        out = super().reveal_field_sum(recipient, aggregation_id, n_submitted)
+        # remember the realized cohort so privacy() reports the guarantee
+        # the revealed aggregate actually has (dropout shrinks the total
+        # noise: realized sigma_total = sqrt(n_actual) * sigma_party)
+        self._revealed_n = n_submitted
+        return out
+
+    def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
+        """Realized guarantee. Defaults to the submitter count of the last
+        reveal when one happened; before any reveal it reports the
+        configured target (``expected_participants``)."""
+        if n_actual is None:
+            n_actual = getattr(self, "_revealed_n", None)
+        return self.dp.account(self.spec.scale, self.wire_dimension, n_actual)
+
+
+class DPFederatedAveraging(_DPRoundMixin, FederatedAveraging):
     """FedAvg round with distributed-DP noise on every update.
 
     Participants L2-clip to ``dp.l2_clip`` (scaling down, not rejecting:
@@ -367,22 +426,7 @@ class DPFederatedAveraging(FederatedAveraging):
         self.dp = dp
         self._rng = np.random.default_rng() if rng is None else rng
         # fail at construction, not first submit
-        sigma = dp.sigma_party_field(spec.scale, self.dim)
-        if sigma < dp.min_party_sigma:
-            raise ValueError(
-                f"per-party sigma {sigma:.3f} < min_party_sigma "
-                f"{dp.min_party_sigma}; raise noise_multiplier or frac_bits"
-            )
-        # a data-only-fitted field (plain QuantizationSpec.fitted) accepts
-        # the data sum but wraps under aggregate noise — require the
-        # NOISE_TAIL_SIGMAS margin the mechanism was accounted with
-        need = dp.field_need(spec.scale, self.dim)
-        if not need < (spec.modulus - 1) // 2:
-            raise ValueError(
-                f"field {spec.modulus} lacks noise headroom: data + "
-                f"{NOISE_TAIL_SIGMAS:g}sigma needs > {int(2 * need) + 1}; "
-                "build the spec with DPFederatedAveraging.fitted_spec"
-            )
+        self._check_dp_feasible(builder="DPFederatedAveraging.fitted_spec")
 
     @classmethod
     def fitted_spec(cls, frac_bits: int, dp: DPConfig, dim: int, **shamir_kw):
@@ -405,22 +449,6 @@ class DPFederatedAveraging(FederatedAveraging):
         # (-|noise|, p + |noise|); numpy % with a positive modulus is the
         # canonical [0, p) representative either side of zero
         participant.participate((q + noise) % self.spec.modulus, aggregation_id)
-
-    def reveal_field_sum(self, recipient, aggregation_id, n_submitted: int):
-        out = super().reveal_field_sum(recipient, aggregation_id, n_submitted)
-        # remember the realized cohort so privacy() reports the guarantee
-        # the revealed aggregate actually has (dropout shrinks the total
-        # noise: realized sigma_total = sqrt(n_actual) * sigma_party)
-        self._revealed_n = n_submitted
-        return out
-
-    def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
-        """Realized guarantee. Defaults to the submitter count of the last
-        reveal when one happened; before any reveal it reports the
-        configured target (``expected_participants``)."""
-        if n_actual is None:
-            n_actual = getattr(self, "_revealed_n", None)
-        return self.dp.account(self.spec.scale, self.dim, n_actual)
 
 
 class DPSecureStatistics(SecureStatistics):
@@ -464,6 +492,70 @@ class DPSecureStatistics(SecureStatistics):
 
     def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
         return self.fed.privacy(n_actual)
+
+
+class DPWeightedFederatedAveraging(_DPRoundMixin, WeightedFederatedAveraging):
+    """Weighted FedAvg under distributed DP — noise covers updates AND
+    weights (a site's exact sample count is itself sensitive).
+
+    The wire channel is ``(w·x, w)`` with ``|x_i| ≤ clip`` (L∞) and
+    ``w ≤ max_weight``, so its L2 bound is
+    ``max_weight·sqrt(clip²·d + 1)`` — the DP clip; in-bounds
+    submissions are never rescaled. ``finish_round`` divides the noisy
+    weighted sum by the noisy total weight: the ratio's noise scale is
+    ``σ_total/(Σw·2^f)`` per coordinate plus a relative error of
+    ``σ_total/(Σw·2^f)`` from the denominator — keep ``Σw`` well above
+    the noise (e.g. n·E[w] ≫ σ_total/2^f) or widen ε.
+    """
+
+    def __init__(self, spec: QuantizationSpec, template_tree, clip: float,
+                 max_weight: float, dp: DPConfig, rng=None):
+        super().__init__(spec, template_tree, clip, max_weight)
+        self.dp = dp
+        self._rng = np.random.default_rng() if rng is None else rng
+        # per-coordinate bound is max(clip*max_weight, max_weight), NOT the
+        # channel L2 (the default would demand a ~sqrt(d)-too-large field)
+        self._check_dp_feasible(
+            per_coordinate_bound=max(self.clip * self.max_weight,
+                                     self.max_weight),
+            builder=".fitted_dp",
+        )
+
+    @classmethod
+    def fitted_dp(cls, frac_bits: int, clip: float, max_weight: float,
+                  n_participants: int, template_tree, *,
+                  noise_multiplier: float, delta: float = 1e-6,
+                  mechanism: str = "dgauss", rng=None, **shamir_kw):
+        """(driver, sharing) with the channel's tight DP clip and a field
+        holding data + noise tail."""
+        from .federated import tree_layout
+
+        _, _, dim = tree_layout(template_tree)
+        l2 = max_weight * math.sqrt(clip * clip * dim + 1.0)
+        dp = DPConfig(
+            l2_clip=l2, noise_multiplier=noise_multiplier,
+            expected_participants=n_participants, delta=delta,
+            mechanism=mechanism,
+        )
+        wire = dim + 1
+        scale = 1 << frac_bits
+        # per-coordinate bound for the field: clip*max_weight (w*x channel)
+        # inflated so n*scale*clip_eff equals DPConfig.field_need
+        bound = max(clip * max_weight, max_weight)
+        clip_eff = dp.field_need(scale, wire, bound) / (n_participants * scale)
+        spec, sharing = QuantizationSpec.fitted(
+            frac_bits, clip_eff, n_participants, **shamir_kw
+        )
+        return cls(spec, template_tree, clip, max_weight, dp, rng=rng), sharing
+
+    def submit_update(self, participant, aggregation_id, update_tree,
+                      weight: float, *, rng=None):
+        q = self._quantized_wire(update_tree, weight).astype(np.int64)
+        noise = self.dp.party_noise(
+            self.spec.scale, self.wire_dimension,
+            self._rng if rng is None else rng,
+        )
+        participant.participate((q + noise) % self.spec.modulus, aggregation_id)
 
 
 class DPSecureCovariance(SecureCovariance):

@@ -369,8 +369,9 @@ class WeightedFederatedAveraging(FederatedAveraging):
             title=title, masking_scheme=masking_scheme,
         )
 
-    def submit_update(self, participant, aggregation_id, update_tree,
-                      weight: float):
+    def _quantized_wire(self, update_tree, weight: float) -> np.ndarray:
+        """Validate and build the quantized ``(w·x, w)`` field vector —
+        shared by the plain and DP submit paths."""
         if not 0 < weight <= self.max_weight:
             raise ValueError(
                 f"weight {weight} outside (0, {self.max_weight}]"
@@ -381,7 +382,14 @@ class WeightedFederatedAveraging(FederatedAveraging):
                 f"update coordinates exceed the clip bound {self.clip}"
             )
         wire = np.concatenate([flat * weight, [float(weight)]])
-        participant.participate(self.spec.quantize(wire), aggregation_id)
+        return self.spec.quantize(wire)
+
+    def submit_update(self, participant, aggregation_id, update_tree,
+                      weight: float):
+        # validate/build before touching `participant` (attribute lookup
+        # on the call target happens before argument evaluation)
+        wire = self._quantized_wire(update_tree, weight)
+        participant.participate(wire, aggregation_id)
 
     def finish_round(self, recipient, aggregation_id, n_submitted: int):
         """-> (weighted-mean pytree, total weight)."""

@@ -491,3 +491,62 @@ def test_dp_covariance_round(tmp_path):
     vech = np.outer(x, x)[np.triu_indices(dim)]
     true_norm = np.sqrt((x ** 2).sum() + (vech ** 2).sum())
     assert abs(true_norm - sc.dp.l2_clip) < 1e-9
+
+
+def test_dp_weighted_fedavg_round(tmp_path):
+    """DP weighted FedAvg: exact noise replay through the protocol, the
+    noisy weighted mean lands near truth, and privacy reflects the
+    revealed cohort."""
+    from sda_tpu.models.dp import DPWeightedFederatedAveraging
+
+    dim, n = 5, 3
+    fed, sharing = DPWeightedFederatedAveraging.fitted_dp(
+        16, clip=1.0, max_weight=50.0, n_participants=n,
+        template_tree={"w": np.zeros(dim)},
+        noise_multiplier=0.005, rng=np.random.default_rng(0),
+    )
+    rng = np.random.default_rng(4)
+    data = rng.uniform(-1, 1, size=(n, dim))
+    weights = [10.0, 25.0, 40.0]
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = fed.open_round(recipient, rkey, sharing)
+        for i in range(n):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            fed.submit_update(part, agg_id, {"w": data[i]},
+                              weight=weights[i],
+                              rng=np.random.default_rng(3000 + i))
+        fed.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        revealed = fed.reveal_field_sum(recipient, agg_id, n)
+
+    # bit-exact replay of the integer pipeline
+    wire_dim = dim + 1
+    total = np.zeros(wire_dim, dtype=np.int64)
+    for i in range(n):
+        wire = np.concatenate([data[i] * weights[i], [weights[i]]])
+        q = fed.spec.quantize(wire).astype(np.int64)
+        noise = fed.dp.party_noise(fed.spec.scale, wire_dim,
+                                   np.random.default_rng(3000 + i))
+        total += q + noise
+    np.testing.assert_array_equal(revealed, total % fed.spec.modulus)
+
+    # the decoded weighted mean is near truth at this small z
+    sums = fed.spec.dequantize_sum(revealed)
+    got_mean = sums[:dim] / sums[-1]
+    want = np.average(data, axis=0, weights=weights)
+    sigma = fed.dp.sigma_total_field(fed.spec.scale, wire_dim)
+    tol = 8 * sigma / (sum(weights) * fed.spec.scale) + 0.01
+    np.testing.assert_allclose(got_mean, want, atol=tol)
+
+    assert fed.privacy(n).epsilon > 0
+    # rejects rather than silently rescales
+    with pytest.raises(ValueError, match="clip bound"):
+        fed.submit_update(object(), object(), {"w": np.full(dim, 2.0)},
+                          weight=1.0)
+    with pytest.raises(ValueError, match="weight"):
+        fed.submit_update(object(), object(), {"w": np.zeros(dim)},
+                          weight=51.0)
