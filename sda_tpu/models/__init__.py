@@ -38,6 +38,7 @@ from .statistics import (
     SecureCountDistinct,
     SecureCovariance,
     SecureFrequency,
+    SecureGroupedMean,
     SecureHistogram,
     SecureQuantiles,
     SecureStatistics,
@@ -71,6 +72,7 @@ __all__ = [
     "SecureCountDistinct",
     "SecureCovariance",
     "SecureEvaluation",
+    "SecureGroupedMean",
     "WeightedFederatedAveraging",
     "SecureFrequency",
     "SecureHistogram",

@@ -259,6 +259,92 @@ class SecureHistogram:
         return self.fed.reveal_field_sum(recipient, aggregation_id, n_submitted)
 
 
+class SecureGroupedMean:
+    """Per-category cohort means ("mean latency by region"), privately.
+
+    Each participant holds observations ``(category, value-vector)`` with
+    categories in ``{0, …, groups-1}`` and ``|value coordinate| ≤ clip``.
+    It submits a scatter: a ``(groups, dim)`` matrix of its per-category
+    value sums plus a ``(groups,)`` count vector — zeros everywhere it
+    has no data. The revealed sums give exact per-category totals and
+    counts, hence per-category means, without revealing which categories
+    any participant contributed to (the zero rows are masked/shared like
+    everything else).
+
+    ``max_values_per_participant`` bounds one participant's observation
+    count (the field is sized for ``n · max_values · clip`` per
+    coordinate — all of one participant's mass can land in one cell).
+    """
+
+    def __init__(self, groups: int, dim: int, clip: float,
+                 n_participants: int, *, frac_bits: int = 16,
+                 max_values_per_participant: int = 1 << 10):
+        if groups < 1 or dim < 1:
+            raise ValueError("groups and dim must be >= 1")
+        self.groups = groups
+        self.dim = dim
+        self.clip = float(clip)
+        self.max_values = max_values_per_participant
+        bound = max(clip, 1.0) * max_values_per_participant
+        self.spec, self.sharing = QuantizationSpec.fitted(
+            frac_bits, bound, n_participants
+        )
+        template = {
+            "sums": np.zeros((groups, dim)),
+            "counts": np.zeros(groups),
+        }
+        self.fed = FederatedAveraging(self.spec, template)
+
+    def local_scatter(self, observations) -> dict:
+        """``[(category, value-vector), …]`` -> this participant's
+        {"sums", "counts"} contribution."""
+        sums = np.zeros((self.groups, self.dim))
+        counts = np.zeros(self.groups)
+        observations = list(observations)
+        if len(observations) > self.max_values:
+            raise ValueError(f"more than {self.max_values} observations")
+        for cat, vec in observations:
+            cat = int(cat)
+            if not 0 <= cat < self.groups:
+                raise ValueError(f"category {cat} outside [0, {self.groups})")
+            vec = _validate_vector(vec, self.dim, self.clip)
+            sums[cat] += vec
+            counts[cat] += 1
+        return {"sums": sums, "counts": counts}
+
+    def open_round(self, recipient, recipient_key):
+        return self.fed.open_round(
+            recipient, recipient_key, self.sharing, title="secure-grouped-mean"
+        )
+
+    def submit(self, participant, aggregation_id, observations) -> None:
+        self.fed.submit_update(
+            participant, aggregation_id, self.local_scatter(observations)
+        )
+
+    def close_round(self, recipient, aggregation_id) -> None:
+        self.fed.close_round(recipient, aggregation_id)
+
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """-> {"counts": (groups,) int64, "means": (groups, dim) float64,
+        NaN rows for categories nobody contributed to}."""
+        from .federated import unflatten_pytree
+
+        raw = self.fed.reveal_field_sum(recipient, aggregation_id, n_submitted)
+        # decode by name through the stored layout — no dependence on the
+        # pytree's key ordering
+        tree = unflatten_pytree(
+            self.spec.dequantize_sum(raw), self.fed.treedef, self.fed.shapes
+        )
+        counts = np.rint(tree["counts"]).astype(np.int64)
+        totals = tree["sums"]
+        g, d = self.groups, self.dim
+        means = np.full((g, d), np.nan)
+        nonzero = counts > 0
+        means[nonzero] = totals[nonzero] / counts[nonzero, None]
+        return {"counts": counts, "means": means}
+
+
 def quantiles_from_histogram(counts, lo: float, hi: float, qs) -> np.ndarray:
     """Quantile estimates from equal-width bin ``counts`` over ``[lo, hi)``.
 

@@ -467,3 +467,52 @@ def test_secure_evaluation_reserved_and_duplicate_names():
         SecureEvaluation(["examples", "loss"], n_participants=2)
     with pytest.raises(ValueError, match="duplicate"):
         SecureEvaluation(["loss", "loss"], n_participants=2)
+
+
+# --- grouped means ----------------------------------------------------------
+
+
+def test_secure_grouped_mean_round(tmp_path):
+    """Per-category means through the full protocol: exact counts, exact
+    group means to quantization, NaN for empty groups."""
+    from sda_tpu.models.statistics import SecureGroupedMean
+
+    gm = SecureGroupedMean(groups=3, dim=2, clip=5.0, n_participants=4,
+                           frac_bits=18, max_values_per_participant=10)
+    obs = [
+        [(0, [1.0, 2.0]), (1, [3.0, 4.0]), (0, [2.0, 0.0])],
+        [(1, [1.0, 1.0])],
+        [(0, [0.5, 0.5]), (1, [2.0, 2.0]), (1, [0.0, 3.0])],
+    ]  # group 2 stays empty
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = gm.open_round(recipient, rkey)
+        for i, o in enumerate(obs):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            gm.submit(part, agg_id, o)
+        gm.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = gm.finish(recipient, agg_id, len(obs))
+
+    np.testing.assert_array_equal(result["counts"], [3, 4, 0])
+    want0 = np.mean([[1, 2], [2, 0], [0.5, 0.5]], axis=0)
+    want1 = np.mean([[3, 4], [1, 1], [2, 2], [0, 3]], axis=0)
+    np.testing.assert_allclose(result["means"][0], want0, atol=1e-3)
+    np.testing.assert_allclose(result["means"][1], want1, atol=1e-3)
+    assert np.isnan(result["means"][2]).all()
+
+
+def test_secure_grouped_mean_validation():
+    from sda_tpu.models.statistics import SecureGroupedMean
+
+    gm = SecureGroupedMean(groups=2, dim=2, clip=1.0, n_participants=2,
+                           max_values_per_participant=2)
+    with pytest.raises(ValueError, match="category 5"):
+        gm.local_scatter([(5, [0.0, 0.0])])
+    with pytest.raises(ValueError, match="clip bound"):
+        gm.local_scatter([(0, [2.0, 0.0])])
+    with pytest.raises(ValueError, match="more than 2"):
+        gm.local_scatter([(0, [0, 0])] * 3)
