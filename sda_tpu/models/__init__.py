@@ -16,6 +16,7 @@ from .dp import (
     DPConfig,
     DPFederatedAveraging,
     DPSecureCovariance,
+    DPSecureGroupedMean,
     DPSecureHistogram,
     DPSecureStatistics,
     DPWeightedFederatedAveraging,
@@ -55,6 +56,7 @@ __all__ = [
     "DPConfig",
     "DPFederatedAveraging",
     "DPSecureCovariance",
+    "DPSecureGroupedMean",
     "DPSecureHistogram",
     "DPSecureStatistics",
     "DPWeightedFederatedAveraging",

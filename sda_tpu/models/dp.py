@@ -57,7 +57,12 @@ from .federated import (
     QuantizationSpec,
     WeightedFederatedAveraging,
 )
-from .statistics import SecureCovariance, SecureHistogram, SecureStatistics
+from .statistics import (
+    SecureCovariance,
+    SecureGroupedMean,
+    SecureHistogram,
+    SecureStatistics,
+)
 
 # Field headroom reserved for aggregate noise, in units of sigma_total.
 # Sub-Gaussian tail: P(|noise| > k*sigma) <= 2*exp(-k^2/2) ~ 5e-32 at 12.
@@ -421,22 +426,28 @@ class DPFederatedAveraging(_DPRoundMixin, FederatedAveraging):
     pipeline. Use ``fitted_spec`` to build a field with noise headroom.
     """
 
-    def __init__(self, spec: QuantizationSpec, template_tree, dp: DPConfig, rng=None):
+    def __init__(self, spec: QuantizationSpec, template_tree, dp: DPConfig,
+                 rng=None, *, per_coordinate_bound: float | None = None):
         super().__init__(spec, template_tree)
         self.dp = dp
         self._rng = np.random.default_rng() if rng is None else rng
-        # fail at construction, not first submit
-        self._check_dp_feasible(builder="DPFederatedAveraging.fitted_spec")
+        # fail at construction, not first submit. Channels with a known
+        # tighter per-coordinate bound than l2_clip pass it here AND to
+        # fitted_spec, keeping builder and guard on one formula.
+        self._check_dp_feasible(
+            per_coordinate_bound, builder="DPFederatedAveraging.fitted_spec"
+        )
 
     @classmethod
-    def fitted_spec(cls, frac_bits: int, dp: DPConfig, dim: int, **shamir_kw):
+    def fitted_spec(cls, frac_bits: int, dp: DPConfig, dim: int,
+                    per_coordinate_bound: float | None = None, **shamir_kw):
         """(spec, sharing) sized for data sum + NOISE_TAIL_SIGMAS·σ_total.
 
         Mirrors ``QuantizationSpec.fitted`` with the per-coordinate bound
         inflated so n·2^f·clip_eff equals ``DPConfig.field_need``."""
         scale = 1 << frac_bits
         n = dp.expected_participants
-        clip_eff = dp.field_need(scale, dim) / (n * scale)
+        clip_eff = dp.field_need(scale, dim, per_coordinate_bound) / (n * scale)
         return QuantizationSpec.fitted(frac_bits, clip_eff, n, **shamir_kw)
 
     def submit_update(self, participant, aggregation_id, update_tree, *, rng=None):
@@ -538,13 +549,10 @@ class DPWeightedFederatedAveraging(_DPRoundMixin, WeightedFederatedAveraging):
             mechanism=mechanism,
         )
         wire = dim + 1
-        scale = 1 << frac_bits
         # per-coordinate bound for the field: clip*max_weight (w*x channel)
-        # inflated so n*scale*clip_eff equals DPConfig.field_need
         bound = max(clip * max_weight, max_weight)
-        clip_eff = dp.field_need(scale, wire, bound) / (n_participants * scale)
-        spec, sharing = QuantizationSpec.fitted(
-            frac_bits, clip_eff, n_participants, **shamir_kw
+        spec, sharing = DPFederatedAveraging.fitted_spec(
+            frac_bits, dp, wire, per_coordinate_bound=bound, **shamir_kw
         )
         return cls(spec, template_tree, clip, max_weight, dp, rng=rng), sharing
 
@@ -556,6 +564,78 @@ class DPWeightedFederatedAveraging(_DPRoundMixin, WeightedFederatedAveraging):
             self._rng if rng is None else rng,
         )
         participant.participate((q + noise) % self.spec.modulus, aggregation_id)
+
+
+class DPSecureGroupedMean(SecureGroupedMean):
+    """Per-category cohort means under distributed DP.
+
+    The scatter channel (``(groups, dim)`` per-category sums + a
+    ``(groups,)`` count vector) has, for one participant with at most
+    ``m = max_values`` observations of ``|coordinate| ≤ c``, the L2
+    bound ``m·sqrt(c²·d + 1)`` — all observations in one category is
+    the worst case (the sums row reaches ``m·c`` per coordinate and the
+    count cell ``m``; splitting mass across categories only lowers the
+    norm). Noisy counts come back as floats (may dip negative); means
+    divide by them only where the noisy count is ≥ 1.
+    """
+
+    def __init__(self, groups: int, dim: int, clip: float,
+                 n_participants: int, *, noise_multiplier: float,
+                 delta: float = 1e-6, frac_bits: int = 16,
+                 max_values_per_participant: int = 1 << 10,
+                 mechanism: str = "dgauss", rng=None):
+        if groups < 1 or dim < 1:
+            raise ValueError("groups and dim must be >= 1")
+        if clip <= 0:
+            raise ValueError("clip must be positive")
+        self.groups = groups
+        self.dim = dim
+        self.clip = float(clip)
+        self.max_values = max_values_per_participant
+        m = max_values_per_participant
+        l2 = m * math.sqrt(clip * clip * dim + 1.0)
+        wire = groups * dim + groups
+        self.dp = DPConfig(
+            l2_clip=l2, noise_multiplier=noise_multiplier,
+            expected_participants=n_participants, delta=delta,
+            mechanism=mechanism,
+        )
+        bound = max(clip, 1.0) * m  # true per-coordinate bound
+        self.spec, self.sharing = DPFederatedAveraging.fitted_spec(
+            frac_bits, self.dp, wire, per_coordinate_bound=bound
+        )
+        template = {
+            "sums": np.zeros((groups, dim)),
+            "counts": np.zeros(groups),
+        }
+        self.fed = DPFederatedAveraging(
+            self.spec, template, self.dp, rng=rng, per_coordinate_bound=bound
+        )
+
+    def submit(self, participant, aggregation_id, observations, *,
+               rng=None) -> None:
+        self.fed.submit_update(
+            participant, aggregation_id, self.local_scatter(observations),
+            rng=rng,
+        )
+
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """-> {"counts": (groups,) float64 noisy counts, "means":
+        (groups, dim) float64 — NaN where the noisy count is < 1}."""
+        from .federated import unflatten_pytree
+
+        raw = self.fed.reveal_field_sum(recipient, aggregation_id, n_submitted)
+        tree = unflatten_pytree(
+            self.spec.dequantize_sum(raw), self.fed.treedef, self.fed.shapes
+        )
+        counts = np.asarray(tree["counts"], dtype=np.float64)
+        means = np.full((self.groups, self.dim), np.nan)
+        usable = counts >= 1.0
+        means[usable] = tree["sums"][usable] / counts[usable, None]
+        return {"counts": counts, "means": means}
+
+    def privacy(self, n_actual: int | None = None) -> PrivacyAccount:
+        return self.fed.privacy(n_actual)
 
 
 class DPSecureCovariance(SecureCovariance):

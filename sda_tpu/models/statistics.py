@@ -281,6 +281,8 @@ class SecureGroupedMean:
                  max_values_per_participant: int = 1 << 10):
         if groups < 1 or dim < 1:
             raise ValueError("groups and dim must be >= 1")
+        if clip <= 0:
+            raise ValueError("clip must be positive")
         self.groups = groups
         self.dim = dim
         self.clip = float(clip)

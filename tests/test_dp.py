@@ -550,3 +550,68 @@ def test_dp_weighted_fedavg_round(tmp_path):
     with pytest.raises(ValueError, match="weight"):
         fed.submit_update(object(), object(), {"w": np.zeros(dim)},
                           weight=51.0)
+
+
+def test_dp_grouped_mean_round(tmp_path):
+    """DP grouped means: exact noise replay through the protocol; empty
+    and noisy-negative groups come back NaN instead of dividing."""
+    from sda_tpu.models.dp import DPSecureGroupedMean
+
+    n = 3
+    gm = DPSecureGroupedMean(groups=3, dim=2, clip=2.0, n_participants=n,
+                             noise_multiplier=0.002, frac_bits=16,
+                             max_values_per_participant=4,
+                             rng=np.random.default_rng(1))
+    obs = [
+        [(0, [1.0, 2.0]), (1, [0.5, 0.5])],
+        [(0, [2.0, 0.0])],
+        [(1, [1.5, 1.5]), (1, [0.5, 0.5])],
+    ]  # group 2 untouched
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = gm.open_round(recipient, rkey)
+        for i, o in enumerate(obs):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            gm.submit(part, agg_id, o, rng=np.random.default_rng(4000 + i))
+        gm.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = gm.finish(recipient, agg_id, n)
+
+    # exact replay of the integer pipeline
+    from sda_tpu.models.federated import flatten_pytree
+
+    wire = gm.groups * gm.dim + gm.groups
+    total = np.zeros(wire, dtype=np.int64)
+    for i, o in enumerate(obs):
+        flat, _, _ = flatten_pytree(gm.local_scatter(o))
+        q = gm.spec.quantize(flat).astype(np.int64)
+        total += q + gm.dp.party_noise(gm.spec.scale, wire,
+                                       np.random.default_rng(4000 + i))
+    want_flat = gm.spec.dequantize_sum(total % gm.spec.modulus)
+    want_counts = want_flat[:gm.groups]  # counts sort before sums
+    np.testing.assert_allclose(result["counts"], want_counts, atol=1e-9)
+
+    # group means land near truth at tiny z; empty group is NaN
+    np.testing.assert_allclose(result["means"][0], [1.5, 1.0], atol=0.05)
+    np.testing.assert_allclose(result["means"][1], [2.5 / 3, 2.5 / 3],
+                               atol=0.05)
+    assert np.isnan(result["means"][2]).all() or result["counts"][2] < 1
+    assert gm.privacy(n).epsilon > 0
+
+
+def test_dp_grouped_mean_moderate_dims_construct():
+    """Regression: the builder and the constructor guard must agree on
+    the per-coordinate bound — at dim=50 the L2-vs-coordinate gap is
+    ~7x and a mismatched guard rejected the builder's own field."""
+    from sda_tpu.models.dp import DPSecureGroupedMean
+
+    gm = DPSecureGroupedMean(groups=4, dim=50, clip=1.0, n_participants=10,
+                             noise_multiplier=0.01,
+                             max_values_per_participant=1024)
+    assert gm.spec.modulus.bit_length() < 40  # tight field, not L2-sized
+    with pytest.raises(ValueError, match="clip must be positive"):
+        DPSecureGroupedMean(groups=2, dim=2, clip=-1.0, n_participants=2,
+                            noise_multiplier=0.1)
