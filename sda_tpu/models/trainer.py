@@ -188,14 +188,21 @@ class FederatedTrainer:
 
     # -- the round loop ------------------------------------------------------
 
-    def run_round(self, recipient, recipient_key, sharing_scheme, submitters, workers):
+    def run_round(self, recipient, recipient_key, sharing_scheme, submitters,
+                  workers, *, parallel_submit: int = 0):
         """One full secure round: open, collect, clerk, reveal, apply, save.
 
         ``submitters``: list of ``(client, update_fn)`` — ``update_fn``
         receives the current global model and returns an update pytree
         (e.g. local SGD delta); each client runs full participation.
         ``workers``: clients that drain clerking queues (committee
-        members among them do the clerking).
+        members among them do the clerking). ``parallel_submit``: >0 runs
+        participations in that many threads — each participant is its own
+        client and the server handles concurrent uploads (the concurrency
+        suite covers this), so simulated cohorts collect ~Nx faster. A DP
+        driver's shared numpy Generator is NOT thread-safe, so when the
+        fed object carries one, each submitter gets its own spawned child
+        generator (deterministic given submitter order).
         """
         agg_id = self.fed.open_round(
             recipient,
@@ -203,8 +210,34 @@ class FederatedTrainer:
             sharing_scheme,
             title=f"federated-round-{self.round_index}",
         )
-        for client, update_fn in submitters:
-            self.fed.submit_update(client, agg_id, update_fn(self.global_model))
+
+        def submit_one(client, update_fn, child_rng=None):
+            update = update_fn(self.global_model)
+            if child_rng is None:
+                self.fed.submit_update(client, agg_id, update)
+            else:
+                self.fed.submit_update(client, agg_id, update, rng=child_rng)
+
+        if parallel_submit > 0:
+            from concurrent.futures import ThreadPoolExecutor
+
+            shared_rng = getattr(self.fed, "_rng", None)
+            rngs = (
+                shared_rng.spawn(len(submitters))
+                if shared_rng is not None
+                else [None] * len(submitters)
+            )
+            with ThreadPoolExecutor(max_workers=parallel_submit) as pool:
+                # list() propagates the first worker exception
+                list(
+                    pool.map(
+                        lambda args: submit_one(args[0][0], args[0][1], args[1]),
+                        zip(submitters, rngs),
+                    )
+                )
+        else:
+            for client, update_fn in submitters:
+                submit_one(client, update_fn)
         self.fed.close_round(recipient, agg_id)
         for worker in workers:
             worker.run_chores(-1)
