@@ -126,6 +126,26 @@ def main():
           f"(r={result['correlation'][i, j]:.2f})")
     print(f"PC1 explains {evals[0] / max(np.trace(result['covariance']), 1e-12):.0%} "
           f"of cohort latency variance; direction={np.round(comps[0], 2)}")
+
+    # --- query 6: per-region mean latency (grouped means) — the scatter
+    # channel hides WHICH regions an org even operates in
+    from sda_tpu.models import SecureGroupedMean
+
+    gm = SecureGroupedMean(groups=3, dim=1, clip=10.0, n_participants=8,
+                           max_values_per_participant=8)
+    agg = gm.open_round(recipient, rkey)
+    region_of = lambda i: i % 3  # org i's deployment regions (demo)
+    for idx, (org, means, _) in enumerate(orgs):
+        obs = [(region_of(idx), [float(means[0])]),
+               (region_of(idx + 1), [float(means[1])])]
+        gm.submit(org, agg, obs)
+    gm.close_round(recipient, agg)
+    for w in [recipient] + clerks:
+        w.run_chores(-1)
+    grouped = gm.finish(recipient, agg, len(orgs))
+    print("per-region mean latency:     "
+          f"{np.round(grouped['means'][:, 0], 2).tolist()} "
+          f"(n per region: {grouped['counts'].tolist()})")
     return 0
 
 
