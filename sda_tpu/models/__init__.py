@@ -1,12 +1,21 @@
-"""Model-facing layer: federated learning over secure aggregation.
+"""Model-facing layer: federated learning + analytics over secure aggregation.
 
 The reference's stated purpose is combining locally trained ML models
 from phones into one global model without revealing any individual model
 (reference README.md:5-15) — but it ships only the integer-vector
 protocol and leaves the model plumbing to the application. This package
-closes that gap for JAX models: pytree flattening, fixed-point
-quantization into the aggregation's prime field, and a FedAvg round
-driver over any ``SdaService``.
+closes that gap:
+
+- **learning**: pytree flattening + fixed-point field quantization,
+  plain and sample-count-weighted FedAvg round drivers over any
+  ``SdaService``, server optimizers (FedAvgM/FedAdam), a multi-round
+  trainer with checkpoint/resume, and secure model evaluation;
+- **analytics**: mean/variance, covariance/correlation (+ federated
+  PCA), exact histograms, quantiles, frequency/heavy-hitters, grouped
+  means, count-distinct sketches;
+- **privacy**: opt-in distributed differential privacy for all of the
+  above (discrete-Gaussian field noise, zCDP accounting, a persisted
+  multi-round composition ledger).
 """
 
 from .dp import (
