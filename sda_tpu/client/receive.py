@@ -14,7 +14,6 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..crypto import signing
 from ..ops.modular import positive
 from ..protocol import Committee, Snapshot, SnapshotId
 

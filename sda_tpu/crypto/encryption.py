@@ -23,7 +23,7 @@ from ..protocol import (
     SodiumEncryptionScheme,
 )
 from ..ops import paillier
-from . import sodium, varint
+from . import sodium
 from .keystore import DecryptionKey, EncryptionKeypair
 
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import shamir
-from ..ops.modular import MAX_SAFE_MODULUS, mod_sum_wide_np, modmatmul_np, rust_rem_np
+from ..ops.modular import MAX_SAFE_MODULUS, mod_sum_wide_np, rust_rem_np
 from ..ops.rng import uniform_mod_host
 from ..protocol import AdditiveSharing, BasicShamirSharing, PackedShamirSharing
 

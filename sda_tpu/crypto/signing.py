@@ -12,7 +12,6 @@ from ..protocol import (
     B32,
     B64,
     Agent,
-    Labelled,
     Signature,
     Signed,
     SigningKey,
