@@ -25,6 +25,22 @@ def test_simple_cli_example():
     assert "result: 0 2 2 4 4 6 6 8 8 10" in proc.stdout, proc.stdout
 
 
+def _cpu_bench_env():
+    """Site-isolated CPU env for bench subprocesses: -S skips this image's
+    sitecustomize (which dials a TPU relay at interpreter start), so the
+    dependency paths must come back explicitly via PYTHONPATH."""
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    dep_paths = [p for p in sys.path if p and not p.startswith(str(repo))]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.pathsep.join(dep_paths + [str(repo)]),
+    )
+    return repo, env
+
+
 def test_bench_cpu_smoke_all_engines():
     """The driver's bench entry must never rot: run every engine path at
     tiny sizes on CPU (subprocess, so the forced-cpu env doesn't leak) and
@@ -32,18 +48,7 @@ def test_bench_cpu_smoke_all_engines():
     import json
     import sys
 
-    repo = pathlib.Path(__file__).resolve().parent.parent
-    # sys.path rather than endswith("site-packages"): Debian-style layouts
-    # use dist-packages, and .pth-injected dirs matter too
-    dep_paths = [p for p in sys.path if p and not p.startswith(str(repo))]
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        # -S skips site processing (this image's sitecustomize dials a TPU
-        # relay at every interpreter start — a CPU smoke test must not
-        # depend on it); add the dependency paths back explicitly
-        PYTHONPATH=os.pathsep.join(dep_paths + [str(repo)]),
-    )
+    repo, env = _cpu_bench_env()
     # --quick pins the narrow 31-bit sumfirst branch (the bare default
     # would force --wide and duplicate that case)
     for extra in (["--quick"], ["--wide"], ["--engine", "participant"]):
@@ -66,3 +71,26 @@ def test_bench_cpu_smoke_all_engines():
         line = json.loads(out.stdout.strip().splitlines()[-1])
         assert line["unit"] == "shared_elements_per_second"
         assert line["value"] > 0
+
+
+def test_bench_deadline_emits_error_metric():
+    """The pre-measurement watchdog contract: when nothing can be
+    measured in time, bench still prints ONE well-formed, error-tagged
+    JSON metric line and exits 2 — never hangs silently (validated
+    against a live wedged device tunnel on 2026-07-30)."""
+    import json
+    import sys
+
+    repo, env = _cpu_bench_env()
+    out = subprocess.run(
+        [
+            sys.executable, "-S", str(repo / "bench.py"),
+            "--participants", "2000", "--dim", "60", "--chunk", "1000",
+            "--quick", "--deadline", "0.2",
+        ],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=240,
+    )
+    assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["value"] == 0 and "deadline" in line["error"]
+    assert "DEADLINE" in out.stderr
