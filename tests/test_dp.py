@@ -186,11 +186,20 @@ def _setup(ctx, tmp_path):
     return recipient, rkey, clerks
 
 
-def test_dp_fedavg_round_exact_noise_flow(tmp_path):
+@pytest.mark.parametrize("seed", [None, 0, 1, 2])
+def test_dp_fedavg_round_exact_noise_flow(seed, tmp_path):
     """The revealed field sum equals quantized data + replayed noise,
-    bit-exactly — DP rides the integer plane without any drift."""
-    dim, n = 12, 4
-    dp = DPConfig(l2_clip=2.0, noise_multiplier=0.05, expected_participants=n,
+    bit-exactly — DP rides the integer plane without any drift. seed=None
+    is the canonical shape; the rest randomize n/dim/noise multiplier
+    (deterministically) so odd shapes get the same exactness guarantee."""
+    if seed is None:
+        dim, n, z = 12, 4, 0.05
+    else:
+        r = np.random.default_rng(7000 + seed)
+        n = int(r.integers(2, 5))
+        dim = int(r.integers(1, 16))
+        z = float(r.uniform(0.005, 0.5))
+    dp = DPConfig(l2_clip=2.0, noise_multiplier=z, expected_participants=n,
                   delta=1e-6)
     spec, sharing = DPFederatedAveraging.fitted_spec(12, dp, dim)
     template = {"w": np.zeros(dim)}
