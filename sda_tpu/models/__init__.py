@@ -54,7 +54,7 @@ from .statistics import (
     SecureStatistics,
     quantiles_from_histogram,
 )
-from .evaluation import SecureEvaluation
+from .evaluation import DPSecureEvaluation, SecureEvaluation
 from .optimizers import FedAdam, FedAvgM, ServerOptimizer
 from .trainer import FederatedTrainer
 
@@ -65,6 +65,7 @@ __all__ = [
     "DPConfig",
     "DPFederatedAveraging",
     "DPSecureCovariance",
+    "DPSecureEvaluation",
     "DPSecureGroupedMean",
     "DPSecureHistogram",
     "DPSecureStatistics",

@@ -23,6 +23,18 @@ import numpy as np
 from .federated import WeightedFederatedAveraging
 
 
+def _checked_metric_layout(metric_names):
+    """Validate the metric-name layout; returns (names, template)."""
+    names = list(metric_names)
+    if not names:
+        raise ValueError("need at least one metric")
+    if "examples" in names:
+        raise ValueError('"examples" is reserved for the total count')
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate metric names")
+    return names, {"metrics": np.zeros(len(names))}
+
+
 class SecureEvaluation:
     """One evaluation round: example-weighted cohort means of ``metrics``.
 
@@ -37,14 +49,7 @@ class SecureEvaluation:
     def __init__(self, metric_names, n_participants: int, *,
                  bound: float = 100.0, max_examples: int = 1 << 20,
                  frac_bits: int = 16):
-        self.metric_names = list(metric_names)
-        if not self.metric_names:
-            raise ValueError("need at least one metric")
-        if "examples" in self.metric_names:
-            raise ValueError('"examples" is reserved for the total count')
-        if len(set(self.metric_names)) != len(self.metric_names):
-            raise ValueError("duplicate metric names")
-        template = {"metrics": np.zeros(len(self.metric_names))}
+        self.metric_names, template = _checked_metric_layout(metric_names)
         self.fed, self.sharing = WeightedFederatedAveraging.fitted(
             frac_bits, float(bound), float(max_examples), n_participants,
             template,
@@ -82,3 +87,33 @@ class SecureEvaluation:
         out = dict(zip(self.metric_names, mean["metrics"]))
         out["examples"] = int(round(total))
         return out
+
+
+class DPSecureEvaluation(SecureEvaluation):
+    """Model evaluation under distributed DP: the revealed cohort
+    metrics AND the total example count carry noise no party can strip
+    (exact totals themselves leak — e.g. a site joining changes the
+    count by its private dataset size).
+
+    Same round flow as ``SecureEvaluation``; the weighted channel runs
+    over ``DPWeightedFederatedAveraging``, whose sensitivity bound
+    covers one site's worst case ``(n·metrics, n)`` contribution. The
+    revealed example count is noisy (reported rounded; noise std
+    ~σ_total/2^f).
+    """
+
+    def __init__(self, metric_names, n_participants: int, *,
+                 noise_multiplier: float, delta: float = 1e-6,
+                 bound: float = 100.0, max_examples: int = 1 << 20,
+                 frac_bits: int = 16, mechanism: str = "dgauss", rng=None):
+        from .dp import DPWeightedFederatedAveraging
+
+        self.metric_names, template = _checked_metric_layout(metric_names)
+        self.fed, self.sharing = DPWeightedFederatedAveraging.fitted_dp(
+            frac_bits, float(bound), float(max_examples), n_participants,
+            template, noise_multiplier=noise_multiplier, delta=delta,
+            mechanism=mechanism, rng=rng,
+        )
+
+    def privacy(self, n_actual: int | None = None):
+        return self.fed.privacy(n_actual)

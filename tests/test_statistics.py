@@ -516,3 +516,37 @@ def test_secure_grouped_mean_validation():
         gm.local_scatter([(0, [2.0, 0.0])])
     with pytest.raises(ValueError, match="more than 2"):
         gm.local_scatter([(0, [0, 0])] * 3)
+
+
+def test_dp_secure_evaluation_round(tmp_path):
+    """DP evaluation: round completes, metrics land near the weighted
+    truth at a small noise multiplier, the count is noisy-but-close, and
+    privacy accounting is live."""
+    from sda_tpu.models.evaluation import DPSecureEvaluation
+
+    ev = DPSecureEvaluation(["loss"], n_participants=3,
+                            noise_multiplier=0.002, bound=5.0,
+                            max_examples=200,
+                            rng=np.random.default_rng(2))
+    sites = [({"loss": 0.8}, 50), ({"loss": 0.4}, 100), ({"loss": 0.2}, 150)]
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = ev.open_round(recipient, rkey)
+        for i, (m, n_ex) in enumerate(sites):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            ev.submit(part, agg_id, m, n_ex)
+        ev.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = ev.finish(recipient, agg_id, len(sites))
+
+    total = sum(n for _, n in sites)
+    want = sum(m["loss"] * n for m, n in sites) / total
+    assert abs(result["examples"] - total) < 50  # noisy count, same scale
+    assert abs(result["loss"] - want) < 0.1
+    assert ev.privacy(len(sites)).epsilon > 0
+    with pytest.raises(ValueError, match="reserved"):
+        DPSecureEvaluation(["examples"], n_participants=2,
+                           noise_multiplier=0.1)
