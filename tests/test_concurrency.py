@@ -20,7 +20,7 @@ from sda_tpu.protocol import (
     SodiumEncryptionScheme,
 )
 
-from sda_fixtures import new_client, new_full_agent, with_server, with_service
+from sda_fixtures import new_client, with_server, with_service
 
 
 def _run_threads(fns):

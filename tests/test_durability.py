@@ -12,8 +12,6 @@ from sda_tpu.protocol import (
     AdditiveSharing,
     Aggregation,
     AggregationId,
-    AgentId,
-    EncryptionKeyId,
     NoMasking,
     SodiumEncryptionScheme,
 )

@@ -8,8 +8,6 @@ from sda_tpu.protocol import (
     AdditiveSharing,
     Aggregation,
     AggregationId,
-    AgentId,
-    EncryptionKeyId,
     FullMasking,
     SodiumEncryptionScheme,
 )

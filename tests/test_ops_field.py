@@ -16,7 +16,7 @@ from sda_tpu.ops.modular import (
     rust_rem_int,
     rust_rem_np,
 )
-from sda_tpu.ops.ntt import dft_matrix, intt, inverse_dft_matrix, ntt
+from sda_tpu.ops.ntt import intt, ntt
 from sda_tpu.ops.rng import uniform_mod_host
 from sda_tpu.ops import chacha, shamir
 from sda_tpu.protocol import PackedShamirSharing

@@ -8,13 +8,11 @@ import numpy as np
 
 from sda_fixtures import new_client, with_service
 from sda_tpu.ops import find_packed_parameters
-from sda_tpu.ops.modular import mod_sum_wide_np, positive, rust_rem_np
+from sda_tpu.ops.modular import mod_sum_wide_np, positive
 from sda_tpu.protocol import (
     AdditiveSharing,
     Aggregation,
     AggregationId,
-    AgentId,
-    EncryptionKeyId,
     FullMasking,
     PackedShamirSharing,
     SodiumEncryptionScheme,
