@@ -1,0 +1,41 @@
+"""Randomized protocol soak: N full in-process rounds across the scheme
+matrix (random sharing/masking/shape/cohort), asserting the exact modular
+sum each time. Complements the pytest sweep with bulk volume.
+
+Usage:  python scripts/soak.py [N]    (default 200; ~0.1 s/round on CPU)
+Exit 0 = every round exact; 1 = any failure (seeds printed, reproducible
+via tests/test_property_fuzz._random_round).
+"""
+
+import os
+import pathlib
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tests"))
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    from test_property_fuzz import _random_round
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    fails = []
+    for seed in range(n):
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                _random_round(10_000 + seed, pathlib.Path(td))
+        except Exception as e:  # noqa: BLE001 — report and continue
+            fails.append(seed)
+            print(f"FAIL seed={10_000 + seed}: {e!r}", file=sys.stderr)
+        if (seed + 1) % 50 == 0:
+            print(f"[soak] {seed + 1}/{n} rounds, {len(fails)} failures",
+                  file=sys.stderr)
+    print(f"soak: {n - len(fails)}/{n} random rounds exact")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
