@@ -34,14 +34,108 @@ import argparse
 import contextlib
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
+import traceback
 
 import numpy as np
 
 
 NORTH_STAR_ELEMS_PER_S_PER_CHIP = (1_000_000 * 100_000) / 60.0 / 8.0
+
+METRIC_NAME = "packed_shamir_secure_sum_throughput_single_chip"
+
+
+def emit_error(msg: str) -> None:
+    """The contract: whatever goes wrong, stdout carries exactly one
+    well-formed error-tagged metric line (never a raw traceback, never
+    silence). Details go to stderr."""
+    print(
+        json.dumps(
+            {
+                "metric": METRIC_NAME,
+                "value": 0,
+                "unit": "shared_elements_per_second",
+                "vs_baseline": 0.0,
+                "error": msg,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(
+            f"[bench] ignoring non-numeric {name}={raw!r}; using {default:g}",
+            file=sys.stderr,
+        )
+        return default
+
+
+def probe_device(timeout_s: float) -> str | None:
+    """Cheaply check the backend is reachable before committing to the
+    full pipeline: a wedged tunneled device blocks ``jax.devices()``
+    inside an uninterruptible native call, so the probe runs in a child
+    process that can be killed. Returns an error string if the probe
+    failed/hung, None if healthy. ``timeout_s <= 0`` disables."""
+    if timeout_s <= 0:
+        return None
+    t0 = time.perf_counter()
+    # same env re-assert as jaxcfg.sync_platform_to_env: the image's axon
+    # sitecustomize writes jax_platforms into jax config at interpreter
+    # start, shadowing JAX_PLATFORMS — without this the child would probe
+    # a different backend than run() will use
+    code = (
+        "import os, jax; env = os.environ.get('JAX_PLATFORMS'); "
+        "env and jax.config.update('jax_platforms', env); "
+        "d = jax.devices(); "
+        "print(f'{len(d)} x {d[0].platform}', flush=True)"
+    )
+    # propagate -S: when bench itself runs site-isolated (tests force CPU
+    # and skip the image's relay-dialing sitecustomize), the probe child
+    # must too, or it would dial the relay the parent deliberately avoided
+    site_flags = ["-S"] if sys.flags.no_site else []
+    proc = subprocess.Popen(
+        [sys.executable, *site_flags, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # escalate gently: a SIGKILL'd JAX client is the documented way
+        # to wedge the tunneled chip for hours, so give the child a
+        # chance to unwind its connection before the hard kill
+        proc.terminate()
+        try:
+            proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return (
+            f"device probe hung >{timeout_s:.0f}s (tunneled device "
+            "wedged?); skipping bench rather than burning the deadline"
+        )
+    if proc.returncode != 0:
+        tail = (err or out or "").strip().splitlines()
+        detail = tail[-1] if tail else "no output"
+        return f"device probe failed rc={proc.returncode}: {detail}"
+    print(
+        f"[bench] device probe ok in {time.perf_counter() - t0:.1f}s: "
+        f"{out.strip()}",
+        file=sys.stderr,
+        flush=True,
+    )
+    return None
 
 
 @contextlib.contextmanager
@@ -93,18 +187,9 @@ def arm_deadline(seconds: float):
             file=sys.stderr,
             flush=True,
         )
-        print(
-            json.dumps(
-                {
-                    "metric": "packed_shamir_secure_sum_throughput_single_chip",
-                    "value": 0,
-                    "unit": "shared_elements_per_second",
-                    "vs_baseline": 0.0,
-                    "error": f"deadline {seconds:.0f}s exceeded before any "
-                    "measurement (device hang?)",
-                }
-            ),
-            flush=True,
+        emit_error(
+            f"deadline {seconds:.0f}s exceeded before any "
+            "measurement (device hang?)"
         )
         os._exit(2)
 
@@ -114,7 +199,7 @@ def arm_deadline(seconds: float):
     return t
 
 
-def main() -> int:
+def parse_args() -> argparse.Namespace:
     parser = argparse.ArgumentParser()
     parser.add_argument("--participants", type=int, default=None)
     parser.add_argument("--dim", type=int, default=None)
@@ -187,18 +272,22 @@ def main() -> int:
         "instead of hanging forever. 0 disables. Default: "
         "$SDA_BENCH_DEADLINE or 3000",
     )
+    parser.add_argument(
+        "--probe",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="before the pipeline, check backend reachability with a "
+        "killable child-process jax.devices() under this timeout; a "
+        "wedged tunnel is reported in the metric line immediately "
+        "instead of burning the full --deadline. 0 disables. Default: "
+        "$SDA_BENCH_PROBE or 150",
+    )
     args = parser.parse_args()
+    if args.probe is None:
+        args.probe = _env_float("SDA_BENCH_PROBE", 150.0)
     if args.deadline is None:
-        try:
-            args.deadline = float(os.environ.get("SDA_BENCH_DEADLINE", 3000))
-        except ValueError:
-            print(
-                f"[bench] ignoring non-numeric SDA_BENCH_DEADLINE="
-                f"{os.environ['SDA_BENCH_DEADLINE']!r}; using 3000",
-                file=sys.stderr,
-            )
-            args.deadline = 3000.0
-    watchdog = arm_deadline(args.deadline)
+        args.deadline = _env_float("SDA_BENCH_DEADLINE", 3000.0)
     if args.engine is None:
         # --no-limbs selects the int64 variant of the per-participant path;
         # honor pre-existing invocations rather than silently ignoring it
@@ -222,7 +311,10 @@ def main() -> int:
     # after preset resolution: args.wide is final here
     if args.pallas and (args.engine != "participant" or args.no_limbs or args.wide):
         parser.error("--pallas applies to the narrow-field limb participant engine")
+    return args
 
+
+def run(args: argparse.Namespace, watchdog) -> int:
     from sda_tpu.ops.jaxcfg import ensure_x64, sync_platform_to_env
 
     sync_platform_to_env()
@@ -484,6 +576,10 @@ def main() -> int:
         got = finalize(np.asarray(acc), np.asarray(plain))
     if got is None:
         print("VERIFICATION FAILED", file=sys.stderr)
+        emit_error(
+            "verification failed: reconstructed aggregate does not match "
+            "the independent plaintext sum"
+        )
         return 1
 
     participants_done = done_segments * seg_chunks * chunk
@@ -503,7 +599,7 @@ def main() -> int:
         file=sys.stderr,
     )
     result = {
-        "metric": "packed_shamir_secure_sum_throughput_single_chip",
+        "metric": METRIC_NAME,
         "value": round(rate, 1),
         "unit": "shared_elements_per_second",
         "vs_baseline": round(rate / NORTH_STAR_ELEMS_PER_S_PER_CHIP, 4),
@@ -519,6 +615,35 @@ def main() -> int:
         result["includes_compile"] = True
     print(json.dumps(result))
     return 0
+
+
+def main() -> int:
+    args = parse_args()
+    # fail fast on an unreachable backend: the wedged-tunnel failure mode
+    # (the axon relay can block jax.devices() for hours) would otherwise
+    # eat the whole --deadline before the watchdog reports it. The probe
+    # has its own timeout, so the deadline watchdog arms only after —
+    # a deadline shorter than the probe must not fire mid-probe and
+    # mislabel a diagnosed wedge as a generic deadline overrun.
+    err = probe_device(args.probe)
+    if err is not None:
+        print(f"[bench] {err}", file=sys.stderr, flush=True)
+        emit_error(err)
+        return 2
+    watchdog = arm_deadline(args.deadline)
+    try:
+        return run(args, watchdog)
+    except (SystemExit, KeyboardInterrupt):
+        # operator Ctrl-C is a deliberate abort, not a failed measurement
+        raise
+    except BaseException as exc:  # noqa: BLE001 — the metric-line contract
+        # covers *any* failure: never a raw traceback on stdout, never
+        # silence. Details still go to stderr for diagnosis.
+        if watchdog is not None:
+            watchdog.cancel()  # exactly ONE metric line, even at the deadline
+        traceback.print_exc()
+        emit_error(f"{type(exc).__name__}: {exc}")
+        return 2
 
 
 if __name__ == "__main__":

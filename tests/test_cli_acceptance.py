@@ -38,6 +38,10 @@ def _cpu_bench_env():
         JAX_PLATFORMS="cpu",
         PYTHONPATH=os.pathsep.join(dep_paths + [str(repo)]),
     )
+    # ambient overrides (e.g. left exported while iterating on bench)
+    # must not change which code path each test exercises
+    env.pop("SDA_BENCH_PROBE", None)
+    env.pop("SDA_BENCH_DEADLINE", None)
     return repo, env
 
 
@@ -94,3 +98,56 @@ def test_bench_deadline_emits_error_metric():
     line = json.loads(out.stdout.strip().splitlines()[-1])
     assert line["value"] == 0 and "deadline" in line["error"]
     assert "DEADLINE" in out.stderr
+
+
+def test_bench_crash_emits_error_metric():
+    """The metric-line contract covers *exceptions*, not just hangs: a
+    backend-init failure (here: a bogus JAX platform, the same shape as
+    round 1's UNAVAILABLE crash at jax.devices()) must still produce ONE
+    error-tagged JSON metric line and exit 2 — never a raw traceback on
+    stdout. --probe 0 forces the crash to happen inside the pipeline
+    itself rather than being caught by the reachability probe."""
+    import json
+    import sys
+
+    repo, env = _cpu_bench_env()
+    env["JAX_PLATFORMS"] = "nonexistent-backend"
+    out = subprocess.run(
+        [
+            sys.executable, "-S", str(repo / "bench.py"),
+            "--participants", "2000", "--dim", "60", "--chunk", "1000",
+            "--quick", "--probe", "0",
+        ],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=240,
+    )
+    assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+    stdout_lines = out.stdout.strip().splitlines()
+    assert len(stdout_lines) == 1, out.stdout
+    line = json.loads(stdout_lines[0])
+    assert line["value"] == 0 and line["vs_baseline"] == 0.0
+    assert "error" in line and line["error"]
+    assert "Traceback" in out.stderr  # diagnosis preserved on stderr
+
+
+def test_bench_probe_reports_unreachable_backend():
+    """The cheap pre-flight probe fails fast on an unreachable backend
+    (child process, killable — unlike an in-process jax.devices() on a
+    wedged tunnel) and surfaces it in the metric line, exit 2."""
+    import json
+    import sys
+
+    repo, env = _cpu_bench_env()
+    env["JAX_PLATFORMS"] = "nonexistent-backend"
+    out = subprocess.run(
+        [
+            sys.executable, "-S", str(repo / "bench.py"),
+            "--participants", "2000", "--dim", "60", "--chunk", "1000",
+            "--quick", "--probe", "150",
+        ],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=240,
+    )
+    assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+    stdout_lines = out.stdout.strip().splitlines()
+    assert len(stdout_lines) == 1, out.stdout
+    line = json.loads(stdout_lines[0])
+    assert line["value"] == 0 and "probe" in line["error"]
