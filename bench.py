@@ -120,7 +120,14 @@ def probe_device(timeout_s: float) -> str | None:
             proc.communicate(timeout=15)
         except subprocess.TimeoutExpired:
             proc.kill()
-            proc.communicate()
+            try:
+                # even SIGKILL can't reap a child stuck in an
+                # uninterruptible device call (D state) — don't let the
+                # probe itself hang on it; the error return below still
+                # gets the metric line out
+                proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
         return (
             f"device probe hung >{timeout_s:.0f}s (tunneled device "
             "wedged?); skipping bench rather than burning the deadline"

@@ -565,6 +565,17 @@ class DPWeightedFederatedAveraging(_DPRoundMixin, WeightedFederatedAveraging):
         )
         participant.participate((q + noise) % self.spec.modulus, aggregation_id)
 
+    def _weighted_flat(self, sums, total_weight: float) -> np.ndarray:
+        """Unlike the noise-free base (which raises on a non-positive
+        total), a noisy denominator can legitimately dip ≤ 0 for small
+        cohorts — and by reveal time the privacy budget is already
+        spent, so failing hard would waste it. NaN mean + the noisy
+        total let the caller judge usability, mirroring
+        ``DPSecureGroupedMean``'s noisy-count handling."""
+        if total_weight > 0:
+            return sums[: self.dim] / total_weight
+        return np.full(self.dim, np.nan)
+
 
 class DPSecureGroupedMean(SecureGroupedMean):
     """Per-category cohort means under distributed DP.

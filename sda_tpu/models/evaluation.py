@@ -115,5 +115,18 @@ class DPSecureEvaluation(SecureEvaluation):
             mechanism=mechanism, rng=rng,
         )
 
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """Like the base, but ``"examples"`` stays the noisy float — for
+        a tiny cohort it can legitimately come back <= 0 (metrics are
+        NaN then); rounding it to an int would dress noise up as an
+        exact count, and raising would waste the already-charged
+        privacy budget. The caller judges usability."""
+        mean, total = self.fed.finish_round(
+            recipient, aggregation_id, n_submitted
+        )
+        out = dict(zip(self.metric_names, mean["metrics"]))
+        out["examples"] = float(total)
+        return out
+
     def privacy(self, n_actual: int | None = None):
         return self.fed.privacy(n_actual)

@@ -396,9 +396,16 @@ class WeightedFederatedAveraging(FederatedAveraging):
         field_sum = self.reveal_field_sum(recipient, aggregation_id, n_submitted)
         sums = self.spec.dequantize_sum(field_sum)
         total_weight = float(sums[-1])
-        if total_weight <= 0:
-            raise ValueError("revealed total weight is not positive")
         mean = unflatten_pytree(
-            sums[: self.dim] / total_weight, self.treedef, self.shapes
+            self._weighted_flat(sums, total_weight), self.treedef, self.shapes
         )
         return mean, total_weight
+
+    def _weighted_flat(self, sums, total_weight: float) -> np.ndarray:
+        """Policy hook: the flat mean given the revealed sums and total.
+        Noise-free weights are sums of positive submissions, so a
+        non-positive total means something is deeply wrong — fail. The
+        DP subclass overrides this (a noisy total can dip <= 0)."""
+        if total_weight <= 0:
+            raise ValueError("revealed total weight is not positive")
+        return sums[: self.dim] / total_weight

@@ -463,6 +463,34 @@ class SecureCountDistinct(SecureHistogram):
         self.fed = FederatedAveraging(self.spec, {"counts": np.zeros(m)})
         self.salt = salt
 
+    @staticmethod
+    def _canonical_bytes(item) -> bytes:
+        """Type-tagged canonical encoding of one item.
+
+        The union estimate is only correct when equal logical items hash
+        identically on *every* participant — ``repr`` is not that (numpy
+        scalar reprs differ across numpy versions, e.g. ``np.int64(3)``
+        vs ``3``). Accepted types: str, bytes, int/bool, float and their
+        numpy scalar equivalents; anything else raises. Cross-type
+        equality follows Python set semantics (``{1, 1.0, True}`` is one
+        element), so integral floats and bools encode as their int."""
+        if isinstance(item, bytes):
+            return b"b" + item
+        if isinstance(item, str):
+            return b"s" + item.encode("utf-8")
+        if isinstance(item, (bool, np.bool_, int, np.integer)):
+            return b"i" + str(int(item)).encode("ascii")
+        if isinstance(item, (float, np.floating)):
+            f = float(item)
+            if f.is_integer():
+                return b"i" + str(int(f)).encode("ascii")
+            return b"f" + repr(f).encode("ascii")
+        raise TypeError(
+            f"count-distinct items must be str, bytes, int, or float "
+            f"(got {type(item).__name__}); hash-stable canonical encoding "
+            "is required for the cross-participant union"
+        )
+
     def _bin_of(self, item) -> int:
         import hashlib
 
@@ -470,7 +498,8 @@ class SecureCountDistinct(SecureHistogram):
         # silently truncates at 16 bytes, which would alias long salts
         # sharing a prefix and re-link sketches across rounds)
         h = hashlib.blake2b(
-            self.salt.encode() + b"\x00" + repr(item).encode(), digest_size=8
+            self.salt.encode() + b"\x00" + self._canonical_bytes(item),
+            digest_size=8,
         )
         return int.from_bytes(h.digest(), "big") % self.bins
 

@@ -6,11 +6,21 @@ modules route bulk work through here either way.
 
 from __future__ import annotations
 
+import sys
+
 import numpy as np
 
 try:
     from . import _sdanative as _ext
 except ImportError:  # not built; fall back to the vectorized Python paths
+    _ext = None
+
+if sys.byteorder != "little":
+    # the C plane reads ChaCha keystream words and writes int64
+    # accumulators in native byte order while Python reads the buffers
+    # back as explicit little-endian ('<i8'/'<u4'); on a big-endian host
+    # the two planes would silently produce different masks. No such
+    # host exists in this deployment — refuse rather than risk it.
     _ext = None
 
 

@@ -197,13 +197,22 @@ class FileAggregationsStore(AggregationsStore):
         table = self._participations(aggregation_id)
         for pid in members:
             payload = table.get(pid)
-            if payload is not None:
-                yield Participation.from_json(payload)
+            if payload is None:
+                # the frozen member list IS the count the transpose and
+                # number_of_participations report; silently skipping a
+                # missing payload (partial write, manual cleanup) would
+                # let the count and the rows actually transposed diverge
+                raise ServerError(
+                    f"snapshot {snapshot_id}: snapped participation "
+                    f"{pid} has no payload on disk — store corrupted?"
+                )
+            yield Participation.from_json(payload)
 
     def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
         # the default parses every member's JSON just to count; the
-        # frozen id list already knows (missing files can't arise: the
-        # membership is snapped from the directory listing itself)
+        # frozen id list already knows (a snapped member whose payload
+        # later goes missing makes iter_snapped_participations raise, so
+        # this count can never silently disagree with the rows iterated)
         return len(self.members.get(snapshot_id) or [])
 
     #: above this many snapped participations the transpose switches from

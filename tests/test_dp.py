@@ -624,3 +624,31 @@ def test_dp_grouped_mean_moderate_dims_construct():
     with pytest.raises(ValueError, match="clip must be positive"):
         DPSecureGroupedMean(groups=2, dim=2, clip=-1.0, n_participants=2,
                             noise_multiplier=0.1)
+
+
+def test_dp_weighted_fedavg_nonpositive_noisy_total():
+    """The noisy denominator can dip <= 0 for tiny cohorts; by reveal
+    time the privacy budget is already charged, so finish_round must
+    hand back (NaN mean, noisy total) instead of raising."""
+    from sda_tpu.models.dp import DPWeightedFederatedAveraging
+
+    dim = 4
+    fed, _sharing = DPWeightedFederatedAveraging.fitted_dp(
+        16, clip=1.0, max_weight=50.0, n_participants=3,
+        template_tree={"w": np.zeros(dim)},
+        noise_multiplier=0.005, rng=np.random.default_rng(0),
+    )
+    # a revealed field vector whose dequantized total-weight slot is
+    # negative (noise swamped the tiny cohort's weight mass)
+    wire = np.concatenate([np.zeros(dim), [-0.5]])
+    field = fed.spec.quantize(wire).astype(np.int64)
+    fed.reveal_field_sum = lambda *a, **k: field
+    mean, total = fed.finish_round(object(), object(), 1)
+    assert total < 0
+    assert np.isnan(mean["w"]).all()
+    # a healthy total still divides normally through the same override
+    wire = np.concatenate([np.full(dim, 3.0), [2.0]])
+    fed.reveal_field_sum = lambda *a, **k: fed.spec.quantize(wire).astype(np.int64)
+    mean, total = fed.finish_round(object(), object(), 1)
+    assert abs(total - 2.0) < 1e-3
+    np.testing.assert_allclose(mean["w"], 1.5, atol=1e-3)

@@ -62,3 +62,29 @@ def test_full_loop_on_file_store_with_restart(tmp_path):
 
     out = recipient.reveal_aggregation(agg.id)
     np.testing.assert_array_equal(out.positive().values, [2, 4, 6, 8])
+
+
+def test_snapped_participation_missing_payload_raises(tmp_path):
+    """A snapped member whose payload file has gone missing (partial
+    write, manual cleanup) must fail loudly: the frozen member list is
+    the count the transpose and number_of_participations report, so
+    silently skipping would let count and transposed rows diverge."""
+    import pytest
+
+    from sda_tpu.protocol import AggregationId, ServerError
+    from sda_tpu.server.filestore import FileAggregationsStore
+
+    store = FileAggregationsStore(tmp_path / "aggs")
+    agg_id = AggregationId.random()
+    table = store._participations(agg_id)
+    table.create("p1", {"fake": 1})
+    store.snapshot_participations(agg_id, "snap1")
+
+    import os
+
+    os.unlink(os.path.join(table.path, "p1.json"))
+    with pytest.raises(ServerError, match="no payload"):
+        list(store.iter_snapped_participations(agg_id, "snap1"))
+    # the count still reports the frozen membership (it cannot diverge
+    # silently: any consumer of the rows raises above)
+    assert store.count_participations_snapshot(agg_id, "snap1") == 1

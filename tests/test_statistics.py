@@ -550,3 +550,23 @@ def test_dp_secure_evaluation_round(tmp_path):
     with pytest.raises(ValueError, match="reserved"):
         DPSecureEvaluation(["examples"], n_participants=2,
                            noise_multiplier=0.1)
+
+
+def test_count_distinct_canonical_hashing():
+    """Binning must be stable across numpy versions and scalar types:
+    equal logical items (Python set semantics: {1, 1.0, True} is one
+    element) hash to the same bin on every participant."""
+    cd = SecureCountDistinct(m=512, n_participants=2, salt="s")
+    assert cd._bin_of(3) == cd._bin_of(np.int64(3)) == cd._bin_of(np.int32(3))
+    assert cd._bin_of(3) == cd._bin_of(3.0) == cd._bin_of(np.float64(3.0))
+    assert cd._bin_of(1) == cd._bin_of(True) == cd._bin_of(np.bool_(True))
+    assert cd._bin_of("x") == cd._bin_of(str("x"))
+    # type-tagged: the string "3" is NOT the integer 3
+    assert cd._bin_of("3") != cd._bin_of(3)
+    # non-integral floats keep full precision
+    assert cd._bin_of(2.5) == cd._bin_of(np.float64(2.5))
+    assert cd._bin_of(2.5) != cd._bin_of(2)
+    with pytest.raises(TypeError, match="canonical"):
+        cd._bin_of(object())
+    with pytest.raises(TypeError, match="canonical"):
+        cd._bin_of((1, 2))
