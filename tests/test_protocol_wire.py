@@ -10,18 +10,25 @@ Golden JSON fixtures follow the reference's serde encoding:
 
 import json
 
+import wire_fixtures as WF
+
 from sda_tpu.protocol import (
+    AdditiveEncryptionScheme,
     Agent,
     AgentId,
+    AggregationStatus,
     Aggregation,
     AggregationId,
     AdditiveSharing,
+    B8,
     B32,
     B64,
     Binary,
     ChaChaMasking,
+    ClerkCandidate,
     ClerkingJob,
     ClerkingJobId,
+    ClerkingResult,
     Committee,
     Encryption,
     EncryptionKey,
@@ -34,10 +41,13 @@ from sda_tpu.protocol import (
     PackedShamirSharing,
     Participation,
     ParticipationId,
+    Profile,
     Signature,
     Signed,
     Snapshot,
     SnapshotId,
+    SnapshotResult,
+    SnapshotStatus,
     SodiumEncryptionScheme,
     VerificationKey,
     VerificationKeyId,
@@ -304,3 +314,202 @@ def test_packed_paillier_wire_roundtrip():
         PackedPaillierEncryptionScheme(2, 63, 32, 2048)
     with pytest.raises(ValueError, match="plaintext"):
         PackedPaillierEncryptionScheme(100, 40, 32, 512)
+
+
+# --- reference-authored golden fixtures (tests/wire_fixtures.py) ------------
+# Everything below asserts byte-for-byte compact-JSON equality against
+# strings transcribed from the reference source itself, so these tests can
+# catch this implementation disagreeing with the reference — not merely
+# with itself.
+
+def pin(fixture_text: str, obj, from_json):
+    """Byte-equality (pins field order) + decode round-trip."""
+    assert json.dumps(obj.to_json(), separators=(",", ":")) == fixture_text
+    assert from_json(json.loads(fixture_text)) == obj
+    return obj
+
+
+def test_golden_byte_array_token_stream():
+    """The serde_test stream at byte_arrays.rs:102-151, as JSON."""
+    assert B8().to_json() == WF.B8_ZERO_B64
+    assert B32().to_json() == WF.B32_ZERO_B64
+    assert B64().to_json() == WF.B64_ZERO_B64
+    t = {"a": B8().to_json(), "b": B32().to_json(), "c": B64().to_json()}
+    assert json.dumps(t, separators=(",", ":")) == WF.BYTE_ARRAY_STRUCT
+    # and decode closes the loop (byte_arrays.rs:111-115)
+    assert B8.from_json(WF.B8_ZERO_B64) == B8()
+
+
+def test_golden_crypto_enums():
+    pin(WF.ENCRYPTION_SODIUM, Encryption(Binary(b"\x01\x02")), Encryption.from_json)
+    pin(
+        WF.ENCRYPTION_KEY_SODIUM,
+        EncryptionKey(B32(bytes(32))),
+        EncryptionKey.from_json,
+    )
+    pin(WF.SIGNATURE_SODIUM, Signature(B64(bytes(64))), Signature.from_json)
+    pin(
+        WF.VERIFICATION_KEY_SODIUM,
+        VerificationKey(B32(bytes(32))),
+        VerificationKey.from_json,
+    )
+    pin(WF.MASKING_NONE, NoMasking(), LinearMaskingScheme.from_json)
+    pin(WF.MASKING_FULL, FullMasking(modulus=433), LinearMaskingScheme.from_json)
+    pin(
+        WF.MASKING_CHACHA,
+        ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
+        LinearMaskingScheme.from_json,
+    )
+    pin(
+        WF.SHARING_ADDITIVE,
+        AdditiveSharing(share_count=3, modulus=433),
+        LinearSecretSharingScheme.from_json,
+    )
+    pin(
+        WF.SHARING_PACKED_SHAMIR,
+        PackedShamirSharing(3, 8, 4, 433, 354, 150),
+        LinearSecretSharingScheme.from_json,
+    )
+    pin(
+        WF.ADDITIVE_ENCRYPTION_SODIUM,
+        SodiumEncryptionScheme(),
+        AdditiveEncryptionScheme.from_json,
+    )
+
+
+def test_golden_resources():
+    agent = Agent(
+        id=AgentId(WF.AGENT_UUID),
+        verification_key=Labelled(
+            VerificationKeyId(WF.VKEY_UUID), VerificationKey(B32(bytes(32)))
+        ),
+    )
+    pin(WF.AGENT, agent, Agent.from_json)
+
+    pin(WF.PROFILE_DEFAULT, Profile(owner=AgentId(WF.AGENT_UUID)), Profile.from_json)
+    pin(
+        WF.PROFILE_FULL,
+        Profile(
+            owner=AgentId(WF.AGENT_UUID),
+            name="Alice",
+            twitter_id="@alice",
+            keybase_id="alice_kb",
+            website="https://example.com",
+        ),
+        Profile.from_json,
+    )
+
+    agg = Aggregation(
+        id=AggregationId(WF.AGG_UUID),
+        title="foo",
+        vector_dimension=4,
+        modulus=433,
+        recipient=AgentId(WF.AGENT_UUID),
+        recipient_key=EncryptionKeyId(WF.EKEY_UUID),
+        masking_scheme=ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
+        committee_sharing_scheme=PackedShamirSharing(3, 8, 4, 433, 354, 150),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    pin(WF.AGGREGATION, agg, Aggregation.from_json)
+
+    pin(
+        WF.CLERK_CANDIDATE,
+        ClerkCandidate(
+            id=AgentId(WF.CLERK_UUID), keys=[EncryptionKeyId(WF.CKEY_UUID)]
+        ),
+        ClerkCandidate.from_json,
+    )
+    pin(
+        WF.COMMITTEE,
+        Committee(
+            aggregation=AggregationId(WF.AGG_UUID),
+            clerks_and_keys=[
+                (AgentId(WF.CLERK_UUID), EncryptionKeyId(WF.CKEY_UUID))
+            ],
+        ),
+        Committee.from_json,
+    )
+
+    enc = Encryption(Binary(b"\x01\x02"))
+    for fixture, recipient_encryption in (
+        (WF.PARTICIPATION_NO_RECIPIENT, None),
+        (WF.PARTICIPATION_WITH_RECIPIENT, enc),
+    ):
+        pin(
+            fixture,
+            Participation(
+                id=ParticipationId(WF.PART_UUID),
+                participant=AgentId(WF.AGENT_UUID),
+                aggregation=AggregationId(WF.AGG_UUID),
+                recipient_encryption=recipient_encryption,
+                clerk_encryptions=[(AgentId(WF.CLERK_UUID), enc)],
+            ),
+            Participation.from_json,
+        )
+
+    pin(
+        WF.SNAPSHOT,
+        Snapshot(id=SnapshotId(WF.SNAP_UUID), aggregation=AggregationId(WF.AGG_UUID)),
+        Snapshot.from_json,
+    )
+    pin(
+        WF.CLERKING_JOB,
+        ClerkingJob(
+            id=ClerkingJobId(WF.JOB_UUID),
+            clerk=AgentId(WF.CLERK_UUID),
+            aggregation=AggregationId(WF.AGG_UUID),
+            snapshot=SnapshotId(WF.SNAP_UUID),
+            encryptions=[enc],
+        ),
+        ClerkingJob.from_json,
+    )
+
+    result = ClerkingResult(
+        job=ClerkingJobId(WF.JOB_UUID), clerk=AgentId(WF.CLERK_UUID), encryption=enc
+    )
+    pin(WF.CLERKING_RESULT, result, ClerkingResult.from_json)
+    pin(
+        WF.AGGREGATION_STATUS,
+        AggregationStatus(
+            aggregation=AggregationId(WF.AGG_UUID),
+            number_of_participations=2,
+            snapshots=[
+                SnapshotStatus(
+                    id=SnapshotId(WF.SNAP_UUID),
+                    number_of_clerking_results=8,
+                    result_ready=True,
+                )
+            ],
+        ),
+        AggregationStatus.from_json,
+    )
+    for fixture, masks in (
+        (WF.SNAPSHOT_RESULT, [enc]),
+        (WF.SNAPSHOT_RESULT_NO_MASKS, None),
+    ):
+        pin(
+            fixture,
+            SnapshotResult(
+                snapshot=SnapshotId(WF.SNAP_UUID),
+                number_of_participations=2,
+                clerk_encryptions=[result],
+                recipient_encryptions=masks,
+            ),
+            SnapshotResult.from_json,
+        )
+
+
+def test_golden_signed_key_and_canonical_bytes():
+    """Signed<Labelled<EncryptionKeyId, EncryptionKey>> — the resource
+    whose exact bytes signatures are computed over (helpers.rs:130-142):
+    any drift here breaks signature verification against the reference."""
+    signed = Signed(
+        signature=Signature(B64(bytes(64))),
+        signer=AgentId(WF.AGENT_UUID),
+        body=Labelled(
+            EncryptionKeyId(WF.EKEY_UUID), EncryptionKey(B32(bytes(32)))
+        ),
+    )
+    pin(WF.SIGNED_ENCRYPTION_KEY, signed, signed_encryption_key_from_json)
+    assert canonical_bytes(signed.body) == WF.CANONICAL_LABELLED_KEY

@@ -1,0 +1,187 @@
+"""Reference-authored golden wire fixtures.
+
+Every fixture below is transcribed by hand FROM THE REFERENCE SOURCE
+(/root/reference, baajur/sda) — not derived by running this repo's own
+encoder — so the parity tests in test_protocol_wire.py check this
+implementation against bytes the reference pinned for itself. Provenance
+for every entry is the reference file:line it was transcribed from.
+
+Transcription rules (all from the reference's serde usage, serde 0.8/0.9
+era, none of which this repo's code is consulted for):
+
+- serde_json emits struct fields in DECLARATION ORDER with compact
+  separators when using ``to_vec``/``to_string`` (helpers.rs:136-142
+  signs exactly those bytes);
+- uuid ids serialize as the hyphenated string (helpers.rs:44-61);
+- ``B8``/``B32``/``B64`` serialize as PADDED standard base64
+  (byte_arrays.rs:3-99; the literal strings below appear verbatim in the
+  reference's own serde_test streams at byte_arrays.rs:101-151);
+- ``Binary`` serializes as padded standard base64 (helpers.rs:176-214);
+- enums use serde's external tagging: unit variants as bare strings,
+  newtype variants as ``{"Tag": value}``, struct variants as
+  ``{"Tag": {fields...}}`` (crypto.rs);
+- ``Option`` serializes as ``null``/value (no skip attributes anywhere
+  in resources.rs);
+- ``Vec<(A, B)>`` serializes as an array of 2-arrays (serde tuples).
+
+Fixtures are compact-JSON *strings* (not dicts): byte-for-byte equality
+pins field order, which dict comparison would not.
+"""
+
+# --- byte arrays: the reference's own serde_test token stream ---------------
+# byte_arrays.rs:102-151. "AAAAAAAAAAA=" is asserted verbatim at :109
+# (test_b64_raw) and :120 (test_b64); the B32/B64 strings are the literal
+# Token::Str values in test_serde (:143, :147).
+B8_ZERO_B64 = "AAAAAAAAAAA="
+B32_ZERO_B64 = "AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA="
+B64_ZERO_B64 = (
+    "AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA"
+    "AAAAAAAAAAAAAAAAAAAAAAAAAA=="
+)
+# the JSON image of byte_arrays.rs:126-149's struct T { a: B8, b: B32, c: B64 }
+BYTE_ARRAY_STRUCT = (
+    '{"a":"' + B8_ZERO_B64 + '","b":"' + B32_ZERO_B64 + '","c":"'
+    + B64_ZERO_B64 + '"}'
+)
+
+# --- deterministic ids used across the fixtures -----------------------------
+AGENT_UUID = "0a000000-0000-4000-8000-000000000001"
+VKEY_UUID = "0b000000-0000-4000-8000-000000000002"
+EKEY_UUID = "0c000000-0000-4000-8000-000000000003"
+AGG_UUID = "0d000000-0000-4000-8000-000000000004"
+PART_UUID = "0e000000-0000-4000-8000-000000000005"
+SNAP_UUID = "0f000000-0000-4000-8000-000000000006"
+JOB_UUID = "10000000-0000-4000-8000-000000000007"
+CLERK_UUID = "11000000-0000-4000-8000-000000000008"
+CKEY_UUID = "12000000-0000-4000-8000-000000000009"
+
+# --- crypto enums (crypto.rs) ----------------------------------------------
+# Encryption::Sodium(Binary) — crypto.rs:7-10; Binary base64 of [1, 2]
+ENCRYPTION_SODIUM = '{"Sodium":"AQI="}'
+# EncryptionKey::Sodium(B32) — crypto.rs:14-17
+ENCRYPTION_KEY_SODIUM = '{"Sodium":"' + B32_ZERO_B64 + '"}'
+# Signature::Sodium(B64) — crypto.rs:21-24
+SIGNATURE_SODIUM = '{"Sodium":"' + B64_ZERO_B64 + '"}'
+# VerificationKey::Sodium(B32) — crypto.rs:35-38
+VERIFICATION_KEY_SODIUM = '{"Sodium":"' + B32_ZERO_B64 + '"}'
+
+# LinearMaskingScheme — crypto.rs:42-62; field order modulus /
+# modulus,dimension,seed_bitsize as declared. ChaCha values are the
+# full_loop.rs:43-52 configuration (dim 4, 128-bit seeds, modulus 433).
+MASKING_NONE = '"None"'
+MASKING_FULL = '{"Full":{"modulus":433}}'
+MASKING_CHACHA = '{"ChaCha":{"modulus":433,"dimension":4,"seed_bitsize":128}}'
+
+# LinearSecretSharingScheme — crypto.rs:78-113. Additive is the
+# full_loop.rs:29-32 3-of-3 config; PackedShamir is the
+# full_loop.rs:55-67 / crypto.rs:146-153 config (ω₂=354 order 8,
+# ω₃=150 order 9 mod 433).
+SHARING_ADDITIVE = '{"Additive":{"share_count":3,"modulus":433}}'
+SHARING_PACKED_SHAMIR = (
+    '{"PackedShamir":{"secret_count":3,"share_count":8,'
+    '"privacy_threshold":4,"prime_modulus":433,'
+    '"omega_secrets":354,"omega_shares":150}}'
+)
+
+# AdditiveEncryptionScheme::Sodium — crypto.rs:158-163 (unit variant)
+ADDITIVE_ENCRYPTION_SODIUM = '"Sodium"'
+
+# --- resources (resources.rs, fields in declaration order) ------------------
+# Agent — resources.rs:12-17; Labelled { id, body } — helpers.rs:146-152
+AGENT = (
+    '{"id":"' + AGENT_UUID + '",'
+    '"verification_key":{"id":"' + VKEY_UUID + '",'
+    '"body":' + VERIFICATION_KEY_SODIUM + "}}"
+)
+
+# Profile — resources.rs:24-35 (Options as null; Default is all-None)
+PROFILE_DEFAULT = (
+    '{"owner":"' + AGENT_UUID + '","name":null,"twitter_id":null,'
+    '"keybase_id":null,"website":null}'
+)
+PROFILE_FULL = (
+    '{"owner":"' + AGENT_UUID + '","name":"Alice","twitter_id":"@alice",'
+    '"keybase_id":"alice_kb","website":"https://example.com"}'
+)
+
+# SignedEncryptionKey = Signed<Labelled<EncryptionKeyId, EncryptionKey>>
+# — resources.rs:40, Signed { signature, signer, body } helpers.rs:98-104
+SIGNED_ENCRYPTION_KEY = (
+    '{"signature":' + SIGNATURE_SODIUM + ','
+    '"signer":"' + AGENT_UUID + '",'
+    '"body":{"id":"' + EKEY_UUID + '","body":' + ENCRYPTION_KEY_SODIUM + "}}"
+)
+# canonical signing bytes = serde_json::to_vec of the Labelled body
+# (helpers.rs:130-142: Sign::canonical is serde_json::to_vec(self))
+CANONICAL_LABELLED_KEY = (
+    '{"id":"' + EKEY_UUID + '","body":' + ENCRYPTION_KEY_SODIUM + "}"
+).encode("ascii")
+
+# Aggregation — resources.rs:44-67; the full_loop.rs ChaCha+PackedShamir
+# configuration with the "foo" title (full_loop.rs:11-27 agg_default)
+AGGREGATION = (
+    '{"id":"' + AGG_UUID + '","title":"foo","vector_dimension":4,'
+    '"modulus":433,"recipient":"' + AGENT_UUID + '",'
+    '"recipient_key":"' + EKEY_UUID + '",'
+    '"masking_scheme":' + MASKING_CHACHA + ','
+    '"committee_sharing_scheme":' + SHARING_PACKED_SHAMIR + ','
+    '"recipient_encryption_scheme":' + ADDITIVE_ENCRYPTION_SODIUM + ','
+    '"committee_encryption_scheme":' + ADDITIVE_ENCRYPTION_SODIUM + "}"
+)
+
+# ClerkCandidate — resources.rs:74-79
+CLERK_CANDIDATE = '{"id":"' + CLERK_UUID + '","keys":["' + CKEY_UUID + '"]}'
+
+# Committee — resources.rs:83-88 (Vec<(AgentId, EncryptionKeyId)>)
+COMMITTEE = (
+    '{"aggregation":"' + AGG_UUID + '",'
+    '"clerks_and_keys":[["' + CLERK_UUID + '","' + CKEY_UUID + '"]]}'
+)
+
+# Participation — resources.rs:92-108 (recipient_encryption: Option)
+PARTICIPATION_NO_RECIPIENT = (
+    '{"id":"' + PART_UUID + '","participant":"' + AGENT_UUID + '",'
+    '"aggregation":"' + AGG_UUID + '","recipient_encryption":null,'
+    '"clerk_encryptions":[["' + CLERK_UUID + '",' + ENCRYPTION_SODIUM + "]]}"
+)
+PARTICIPATION_WITH_RECIPIENT = (
+    '{"id":"' + PART_UUID + '","participant":"' + AGENT_UUID + '",'
+    '"aggregation":"' + AGG_UUID + '",'
+    '"recipient_encryption":' + ENCRYPTION_SODIUM + ','
+    '"clerk_encryptions":[["' + CLERK_UUID + '",' + ENCRYPTION_SODIUM + "]]}"
+)
+
+# Snapshot — resources.rs:116-121
+SNAPSHOT = '{"id":"' + SNAP_UUID + '","aggregation":"' + AGG_UUID + '"}'
+
+# ClerkingJob — resources.rs:128-139
+CLERKING_JOB = (
+    '{"id":"' + JOB_UUID + '","clerk":"' + CLERK_UUID + '",'
+    '"aggregation":"' + AGG_UUID + '","snapshot":"' + SNAP_UUID + '",'
+    '"encryptions":[' + ENCRYPTION_SODIUM + "]}"
+)
+
+# ClerkingResult — resources.rs:146-153
+CLERKING_RESULT = (
+    '{"job":"' + JOB_UUID + '","clerk":"' + CLERK_UUID + '",'
+    '"encryption":' + ENCRYPTION_SODIUM + "}"
+)
+
+# AggregationStatus / SnapshotStatus — resources.rs:157-175
+AGGREGATION_STATUS = (
+    '{"aggregation":"' + AGG_UUID + '","number_of_participations":2,'
+    '"snapshots":[{"id":"' + SNAP_UUID + '",'
+    '"number_of_clerking_results":8,"result_ready":true}]}'
+)
+
+# SnapshotResult — resources.rs:179-188 (recipient_encryptions: Option<Vec>)
+SNAPSHOT_RESULT = (
+    '{"snapshot":"' + SNAP_UUID + '","number_of_participations":2,'
+    '"clerk_encryptions":[' + CLERKING_RESULT + '],'
+    '"recipient_encryptions":[' + ENCRYPTION_SODIUM + "]}"
+)
+SNAPSHOT_RESULT_NO_MASKS = (
+    '{"snapshot":"' + SNAP_UUID + '","number_of_participations":2,'
+    '"clerk_encryptions":[' + CLERKING_RESULT + '],'
+    '"recipient_encryptions":null}'
+)
