@@ -76,8 +76,11 @@ class ChaChaMasker(SecretMasker, MaskCombiner, SecretUnmasker):
     """Seed-compressed masks (masking/chacha.rs): upload only the seed.
 
     The uploaded "mask" is the seed's u32 words as i64s (matching the
-    reference's wire shape, chacha.rs:48-52); both sides expand with the
-    deterministic keystream in ``sda_tpu.ops.chacha``.
+    reference's wire shape, chacha.rs:48-52), and the expansion is
+    BIT-EXACT to the reference's rand-0.3 ``ChaChaRng::from_seed`` +
+    ``gen_range(0, m)`` (see ``sda_tpu.ops.chacha`` module doc; oracle
+    test in tests/test_ops_field.py) — a mixed deployment (reference
+    participant with this recipient, or vice versa) unmasks correctly.
     """
 
     def __init__(self, modulus: int, dimension: int, seed_bitsize: int):

@@ -73,8 +73,9 @@ def _chacha_keys(seed_rows: np.ndarray) -> bytes:
 
 def chacha_expand(seed_words, dim: int, modulus: int) -> np.ndarray:
     """One seed -> (dim,) int64 mask in [0, modulus); bit-identical to
-    ``ops.chacha.expand_seed`` (the fallback when the extension is absent
-    or the modulus is out of its 2^63 range)."""
+    ``ops.chacha.expand_seed`` (the fallback when the extension is
+    absent). Moduli above 2^63 raise in the fallback: int64 masks would
+    wrap negative (no legal i64 scheme modulus reaches there)."""
     if _ext is not None and 0 < modulus <= (1 << 63):
         buf = _ext.chacha_expand(_chacha_keys(seed_words), int(dim), int(modulus))
         return np.frombuffer(buf, dtype="<i8").copy()

@@ -202,8 +202,8 @@ static PyObject *open_batch(PyObject *self, PyObject *args) {
  * Bit-identical to sda_tpu/ops/chacha.py expand_seed: classic djb
  * ChaCha20 keystream (zero nonce, 64-bit counter from 0 — libsodium's
  * crypto_stream_chacha20 layout), words consumed in order as u64 pairs
- * (w[2i] << 32) | w[2i+1], rejection-sampled below the largest multiple
- * of the modulus, reduced mod m. Used for the reveal hot loop: expand
+ * (w[2i] << 32) | w[2i+1], rejection-sampled below the rand-0.3
+ * gen_range zone, reduced mod m. Used for the reveal hot loop: expand
  * every participant's seed and fold the masks into one running sum.
  */
 
@@ -215,12 +215,12 @@ static void chacha_expand_key(const unsigned char *key, Py_ssize_t dim,
                               uint64_t m, int64_t *vals, int64_t *acc) {
     static const unsigned char nonce[8] = {0};
     unsigned char block[CHACHA_CHUNK];
-    /* 2^64 mod m == ((uint64_t)-m) % m since (2^64 - m) ≡ 2^64 (mod m);
-     * zone = 2^64 - (2^64 mod m) = largest multiple of m (0 when exact,
-     * in which case no rejection is needed). */
-    uint64_t two64_mod_m = ((uint64_t)0 - m) % m;
-    int reject = two64_mod_m != 0;
-    uint64_t zone = (uint64_t)0 - two64_mod_m;
+    /* rand-0.3 gen_range(0, m) zone: u64::MAX - u64::MAX % m, accept
+     * v < zone (ops/chacha.py rand03_zone — the Python/jnp planes use
+     * the same formula; differs from 2^64 - 2^64 % m exactly when m
+     * divides 2^64, where rand still rejects the top m values). */
+    uint64_t u64_max = ~(uint64_t)0;
+    uint64_t zone = u64_max - (u64_max % m);
     uint64_t counter = 0;
     size_t pos = 0, have = 0; /* empty buffer: first iteration refills */
     for (Py_ssize_t i = 0; i < dim;) {
@@ -241,7 +241,7 @@ static void chacha_expand_key(const unsigned char *key, Py_ssize_t dim,
         memcpy(&w1, block + pos + 4, 4);
         pos += 8;
         uint64_t v = ((uint64_t)w0 << 32) | (uint64_t)w1;
-        if (reject && v >= zone) continue; /* zone==0 means no rejection */
+        if (v >= zone) continue;
         int64_t r = (int64_t)(v % m);
         if (acc) {
             acc[i] = (int64_t)(((uint64_t)acc[i] + (uint64_t)r) % m);

@@ -5,14 +5,29 @@ recipient (re-expansion) must expand it to a dim-length mask *bit-identically*
 or unmasking silently corrupts the result (SURVEY.md hard part #4; reference:
 client/src/crypto/masking/chacha.rs).
 
-Expansion spec (self-contained; this framework is both producer and consumer):
-- Key: the seed's u32 words zero-padded to 8 words (256-bit key), as the
-  reference pads short seeds (rand 0.3 ChaChaRng::from_seed semantics).
-- Stream: classic djb ChaCha20 with 64-bit block counter in words 12-13 and
-  zero nonce, starting at counter 0; output words consumed in order.
-- Draws: consecutive word pairs form u64s as ``(w[2i] << 32) | w[2i+1]``;
-  pairs >= zone are rejected (zone = 2**64 - 2**64 % m) and skipped; accepted
-  pairs reduce mod m. Unbiased, and deterministic given the seed.
+Expansion spec — BIT-EXACT to the reference's rand-0.3
+``ChaChaRng::from_seed(&seed)`` + per-element ``gen_range(0_i64, m)``
+(client/src/crypto/masking/chacha.rs:36-39, 56-77; client/Cargo.toml:18
+pins rand "0.3"), so a mixed deployment (reference participant, this
+recipient — or vice versa) unmasks correctly:
+
+- Key: the seed's u32 words zero-padded to 8 words (256-bit key) — rand
+  0.3 ``reseed`` zips the seed into a zeroed key ("the PRG will use at
+  most 256 bits", chacha.rs:10).
+- Stream: classic djb ChaCha20, zero nonce, block counter starting at 0,
+  all 16 output words consumed in order — rand 0.3's ChaChaRng layout.
+  (rand 0.3 carries a 128-bit counter over words 12-15 where this
+  implementation carries 64 bits over words 12-13; they diverge only
+  after 2^64 blocks ≈ 10^21 draws, unreachable at any real dimension.)
+- Draws: ``gen_range(0, m)`` draws ``next_u64`` = two consecutive u32
+  words as ``(w[2i] << 32) | w[2i+1]`` (rand 0.3's default ``next_u64``
+  takes the high half first), REJECTS values >= zone, and reduces the
+  accepted value mod m. zone = ``u64::MAX - u64::MAX % m`` exactly as
+  rand 0.3's ``Range::construct_range`` computes it — note this differs
+  from the textbook ``2^64 - 2^64 % m`` precisely when m divides 2^64
+  (then rand still rejects the top m values; a spec using the textbook
+  zone would silently diverge from the reference for power-of-two
+  moduli).
 
 Implemented with vectorized numpy uint32 (wrapping arithmetic); block-level
 parallel so a 100K-dim expansion is ~3K independent blocks — the same
@@ -22,6 +37,24 @@ formulation a Pallas port would use.
 from __future__ import annotations
 
 import numpy as np
+
+
+def rand03_zone(modulus: int) -> int:
+    """rand 0.3's rejection zone for ``gen_range(0, modulus)`` on u64
+    draws: accept v < zone, zone = u64::MAX - u64::MAX % range
+    (rand-0.3 distributions/range.rs, integer_impl!). The single
+    definition every backend (numpy here, jnp/Pallas in
+    chacha_pallas.py, C in native/_sdanative.c — asserted equal in
+    tests) must agree with."""
+    if modulus <= 0:
+        raise ValueError("modulus must be positive")
+    if modulus > (1 << 63):
+        # masks are int64 and gen_range draws i64 — above 2^63 the
+        # reduced draws wrap negative in int64, silently corrupting the
+        # aggregate; no legal scheme modulus (i64) can reach here
+        raise ValueError(f"modulus {modulus} exceeds the int64 mask range")
+    u64_max = (1 << 64) - 1
+    return u64_max - (u64_max % modulus)
 
 _CONSTANTS = np.array([0x61707865, 0x3320646E, 0x79622D32, 0x6B206574], dtype=np.uint32)
 
@@ -158,20 +191,22 @@ def expand_seed_jnp(seed_words, dim: int, modulus: int):
 
 
 def expand_seed(seed_words, dim: int, modulus: int) -> np.ndarray:
-    """Expand seed u32 words to a dim-length int64 mask in [0, modulus)."""
-    if modulus <= 0:
-        raise ValueError("modulus must be positive")
-    rejection = (1 << 64) % modulus != 0
-    zone = (1 << 64) - ((1 << 64) % modulus)
+    """Expand seed u32 words to a dim-length int64 mask in [0, modulus).
+
+    Bit-exact to the reference's rand-0.3 expansion (module doc)."""
+    zone = rand03_zone(modulus)
+    # rejection probability q = (u64::MAX % m + 1) / 2^64 — up to 1/2 at
+    # the maximum m = 2^63 — so size each refill from the actual q
+    q = ((1 << 64) - zone) / float(1 << 64)
     out = np.empty(0, dtype=np.int64)
     counter = 0
     while len(out) < dim:
-        need_pairs = (dim - len(out)) + 8  # slack for rare rejections
+        need = dim - len(out)
+        need_pairs = int(need / (1.0 - q)) + 8
         n_blocks = (need_pairs * 2 + 15) // 16
         words = chacha_blocks(seed_words, counter, n_blocks).reshape(-1)
         counter += n_blocks
         u64 = (words[0::2].astype(np.uint64) << np.uint64(32)) | words[1::2].astype(np.uint64)
-        if rejection:
-            u64 = u64[u64 < np.uint64(zone)]
+        u64 = u64[u64 < np.uint64(zone)]
         out = np.concatenate([out, (u64 % np.uint64(modulus)).astype(np.int64)])
     return out[:dim]

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import logging
 
-from .chacha import apply_rounds_jnp, chacha_rounds_jnp, chacha_state_jnp
+from .chacha import apply_rounds_jnp, chacha_rounds_jnp, chacha_state_jnp, rand03_zone
 
 # lane-axis tile: 512 blocks x 16 words x 4 B x 2 (in+out) = 64 KiB of VMEM
 _TILE = 512
@@ -148,12 +148,13 @@ def _window_pairs(dim: int, modulus: int) -> int:
     (first ``dim`` pairs below the rejection zone, in stream order), so
     overgeneration never changes results — the host path (expand_seed)
     produces the identical sequence by extending the stream on demand.
-    Rejection probability ``q = (2^64 mod m) / 2^64`` reaches ~12.5% for a
-    prime just above a power of two, so the window must scale with q, not
-    use a fixed slack."""
-    q = ((1 << 64) % modulus) / float(1 << 64)
-    if q == 0.0:
-        return dim
+    Rejection probability ``q = (u64::MAX % m + 1) / 2^64`` (the rand-0.3
+    zone; never zero — power-of-two moduli reject too) reaches 1/2 at the
+    maximum m = 2^63, so the window must scale with q, not use a fixed
+    slack."""
+    # rand-0.3 zone semantics: 2^64 - zone = u64::MAX % m + 1 values
+    # rejected out of 2^64 (ops/chacha.py module doc)
+    q = ((((1 << 64) - 1) % modulus) + 1) / float(1 << 64)
     import math
 
     expected = dim / (1.0 - q)
@@ -183,8 +184,7 @@ def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "au
     P = seed_words.shape[0]
     if P == 0:
         return jnp.zeros((0, dim), dtype=jnp.int64)
-    rejection = (1 << 64) % modulus != 0
-    zone = (1 << 64) - ((1 << 64) % modulus)
+    zone = rand03_zone(modulus)  # rand-0.3 exact: rejection always applies
     need_pairs = _window_pairs(dim, modulus)
     n_blocks = (need_pairs * 2 + 15) // 16
     states = jax.vmap(lambda s: chacha_state_jnp(s, 0, n_blocks))(seed_words)
@@ -193,14 +193,13 @@ def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "au
     u64 = (words[:, 0::2].astype(jnp.uint64) << jnp.uint64(32)) | words[:, 1::2].astype(
         jnp.uint64
     )
-    if rejection:
-        ok = u64 < jnp.uint64(zone)
-        if int(jnp.sum(ok, axis=1).min()) < dim:
-            raise SlackExhausted(
-                f"seed window of {u64.shape[1]} pairs held < {dim} accepted draws"
-            )
-        order = jnp.argsort(~ok, axis=1, stable=True)  # accepted first, order kept
-        u64 = jnp.take_along_axis(u64, order, axis=1)
+    ok = u64 < jnp.uint64(zone)
+    if int(jnp.sum(ok, axis=1).min()) < dim:
+        raise SlackExhausted(
+            f"seed window of {u64.shape[1]} pairs held < {dim} accepted draws"
+        )
+    order = jnp.argsort(~ok, axis=1, stable=True)  # accepted first, order kept
+    u64 = jnp.take_along_axis(u64, order, axis=1)
     return (u64 % jnp.uint64(modulus)).astype(jnp.int64)[:, :dim]
 
 

@@ -304,3 +304,99 @@ def test_verify_scheme_accepts_valid_and_rejects_degenerate(monkeypatch):
     monkeypatch.setattr(shamir_mod, "share_matrix", lambda s: bad)
     with pytest.raises(ValueError, match="t-privacy violated"):
         verify_scheme(scheme)
+
+
+def test_chacha_expand_matches_rand03_transcription():
+    """expand_seed must be bit-exact to the reference's mask expansion:
+    rand-0.3 ``ChaChaRng::from_seed(&seed)`` + ``gen_range(0_i64, m)``
+    per element (client/src/crypto/masking/chacha.rs:36-39,56-77;
+    client/Cargo.toml pins rand "0.3").
+
+    The oracle below is an independent scalar transcription of rand
+    0.3's algorithm — ChaChaRng (chacha.rs: 16-word buffer in output
+    order, 128-bit counter over words 12..16), the Rng trait's default
+    ``next_u64`` (high u32 first), and ``gen_range``'s zone rejection
+    (distributions/range.rs integer_impl!: zone = MAX - MAX % range,
+    accept strictly below) — sharing no code with the vectorized
+    implementation. Moduli cover: the reference's own 433, primes, a
+    power of two (where the rand zone rejects the top m values even
+    though 2^64 % m == 0 — the case a textbook zone silently gets
+    wrong), and a ~1/3-rejection modulus stressing the refill loop."""
+    M32 = 0xFFFFFFFF
+
+    def rand03_expand(seed_words, dim, m):
+        base = [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574] + [0] * 12
+        for i, w in enumerate(list(seed_words)[:8]):
+            base[4 + i] = int(w) & M32
+
+        def quarter(x, a, b, c, d):
+            x[a] = (x[a] + x[b]) & M32
+            x[d] ^= x[a]
+            x[d] = ((x[d] << 16) | (x[d] >> 16)) & M32
+            x[c] = (x[c] + x[d]) & M32
+            x[b] ^= x[c]
+            x[b] = ((x[b] << 12) | (x[b] >> 20)) & M32
+            x[a] = (x[a] + x[b]) & M32
+            x[d] ^= x[a]
+            x[d] = ((x[d] << 8) | (x[d] >> 24)) & M32
+            x[c] = (x[c] + x[d]) & M32
+            x[b] ^= x[c]
+            x[b] = ((x[b] << 7) | (x[b] >> 25)) & M32
+
+        def u32_stream():
+            counter = [0, 0, 0, 0]
+            while True:
+                inp = base[:12] + counter
+                w = list(inp)
+                for _ in range(10):
+                    quarter(w, 0, 4, 8, 12)
+                    quarter(w, 1, 5, 9, 13)
+                    quarter(w, 2, 6, 10, 14)
+                    quarter(w, 3, 7, 11, 15)
+                    quarter(w, 0, 5, 10, 15)
+                    quarter(w, 1, 6, 11, 12)
+                    quarter(w, 2, 7, 8, 13)
+                    quarter(w, 3, 4, 9, 14)
+                yield from ((w[i] + inp[i]) & M32 for i in range(16))
+                for j in range(4):  # rand 0.3's 128-bit counter
+                    counter[j] = (counter[j] + 1) & M32
+                    if counter[j]:
+                        break
+
+        words = u32_stream()
+        u64_max = (1 << 64) - 1
+        zone = u64_max - u64_max % m
+        out = []
+        while len(out) < dim:
+            v = (next(words) << 32) | next(words)  # next_u64: high half first
+            if v < zone:
+                out.append(v % m)
+        return out
+
+    rng = np.random.default_rng(11)
+    for m in (
+        433,  # the reference's full_loop modulus
+        (1 << 31) - 1,
+        1152921504606846883,  # 60-bit prime
+        1 << 32,  # power of two: rand rejects [2^64 - 2^32, 2^64)
+        256,
+        ((1 << 64) // 3) | 1,  # ~33% rejection: stresses the refill loop
+    ):
+        for seed_len in (4, 8):
+            seed = rng.integers(0, 2**32, size=seed_len, dtype=np.uint32)
+            want = rand03_expand(seed, 300, m)
+            np.testing.assert_array_equal(
+                chacha.expand_seed(seed, 300, m),
+                np.array(want, dtype=np.int64),
+                err_msg=f"modulus {m}",
+            )
+
+
+def test_chacha_expand_rejects_oversized_modulus():
+    """Above 2^63 the reduced draws would wrap negative in the int64 mask
+    — raise instead of silently corrupting the aggregate."""
+    with pytest.raises(ValueError, match="int64"):
+        chacha.expand_seed(np.arange(4, dtype=np.uint32), 8, 2**64 - 59)
+    with pytest.raises(ValueError, match="int64"):
+        chacha.rand03_zone((1 << 63) + 1)
+    assert chacha.rand03_zone(1 << 63) == 1 << 63  # boundary is legal
