@@ -152,9 +152,9 @@ def _window_pairs(dim: int, modulus: int) -> int:
     zone; never zero — power-of-two moduli reject too) reaches 1/2 at the
     maximum m = 2^63, so the window must scale with q, not use a fixed
     slack."""
-    # rand-0.3 zone semantics: 2^64 - zone = u64::MAX % m + 1 values
-    # rejected out of 2^64 (ops/chacha.py module doc)
-    q = ((((1 << 64) - 1) % modulus) + 1) / float(1 << 64)
+    # rand-0.3 zone semantics: 2^64 - zone values rejected out of 2^64,
+    # derived from the one shared zone definition (ops/chacha.py)
+    q = ((1 << 64) - rand03_zone(modulus)) / float(1 << 64)
     import math
 
     expected = dim / (1.0 - q)

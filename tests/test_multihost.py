@@ -158,3 +158,34 @@ def test_hierarchical_wide_limb_accumulators():
         [sum(int(v) for v in secrets[:, j]) % p for j in range(dim)], dtype=np.int64
     )
     np.testing.assert_array_equal(positive(np.asarray(out), p), want)
+
+
+def test_graft_entry_dryrun_all_fabrics():
+    """The driver's multichip dry run must keep verifying every fabric
+    (psum, all_to_all + dropout, hybrid h x p, wide limb) — run it as the
+    driver does, on a virtual 8-device CPU mesh, and require each
+    fabric's OK line."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    out = subprocess.run(
+        [sys.executable, str(repo / "__graft_entry__.py"), "8"],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-1000:]
+    for marker in (
+        "dryrun_multichip OK",
+        "dryrun all_to_all fabric OK",
+        "dropout reconstruction",
+        "dryrun hybrid mesh OK",
+        "dryrun wide (61-bit) sharded path OK",
+    ):
+        assert marker in out.stdout, (marker, out.stdout)
