@@ -65,12 +65,20 @@ def make_hybrid_mesh(
         from jax.experimental import mesh_utils
         from jax.sharding import Mesh
 
-        h_size = h_size or n_proc
+        # multi-slice TPU pods: the DCN unit is the slice. Anywhere
+        # slice_index doesn't distinguish devices (multi-process CPU
+        # reports slice 0 everywhere; single-slice multi-host pods too),
+        # the process is the outer-network unit — and the h default must
+        # count the same granules the mesh builder will group by.
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        by_process = (None in slice_ids) or len(slice_ids) == 1
+        h_size = h_size or (n_proc if by_process else len(slice_ids))
         p_size = p_size or (len(devices) // (h_size * d_size))
         grid = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(1, p_size, d_size),
             dcn_mesh_shape=(h_size, 1, 1),
             devices=devices,
+            process_is_granule=by_process,
         )
         return Mesh(grid, ("h", "p", "d"))
     from jax.sharding import Mesh

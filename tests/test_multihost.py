@@ -189,3 +189,49 @@ def test_graft_entry_dryrun_all_fabrics():
         "dryrun wide (61-bit) sharded path OK",
     ):
         assert marker in out.stdout, (marker, out.stdout)
+
+
+def test_two_process_distributed_round():
+    """Drive initialize_distributed for real: two OS processes join one
+    jax.distributed runtime (2 CPU devices each -> 4 global), build the
+    hybrid mesh with ``h`` spanning processes, and verify the
+    hierarchical secure sum end to end in both."""
+    import os
+    import pathlib
+    import socket
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    dep_paths = [p for p in sys.path if p and not p.startswith(str(repo))]
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        PYTHONPATH=os.pathsep.join(dep_paths + [str(repo)]),
+    )
+    worker = str(repo / "tests" / "multihost_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-S", worker, str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"proc {i} rc={rc}\n{err[-2000:]}"
+        assert f"proc {i}/2 OK" in out, out
