@@ -338,16 +338,26 @@ class SqliteAggregationsStore(AggregationsStore):
             self.db.conn.commit()
 
     def iter_snapped_participations(self, aggregation_id, snapshot_id):
-        # streaming: one indexed scan, constant memory
+        # streaming: one indexed scan, memory bounded to a fetch batch
+        # (a fetchall here would materialize every raw body for the
+        # whole cohort — the exact RAM ceiling this backend exists to
+        # avoid). The lock is released between batches; the frozen
+        # snapshot_members rows make the scan insensitive to concurrent
+        # participation writes.
         with self.db.lock:
-            rows = self.db.conn.execute(
+            cur = self.db.conn.execute(
                 "SELECT p.body FROM snapshot_members m "
                 "JOIN participations p ON p.id = m.participation "
                 "WHERE m.snapshot = ? ORDER BY m.ord",
                 (str(snapshot_id),),
-            ).fetchall()
-        for (body,) in rows:
-            yield Participation.from_json(json.loads(body))
+            )
+        while True:
+            with self.db.lock:
+                rows = cur.fetchmany(1024)
+            if not rows:
+                return
+            for (body,) in rows:
+                yield Participation.from_json(json.loads(body))
 
     def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
         row = self.db.query_one(
@@ -358,11 +368,43 @@ class SqliteAggregationsStore(AggregationsStore):
 
     def iter_snapshot_clerk_jobs_data(
         self, aggregation_id, snapshot_id, clerks_number: int
-    ) -> list:
+    ):
         """The streaming transpose: the SQL engine extracts clerk ``ix``'s
         ciphertext column with json_extract, one indexed pass per clerk —
         the sqlite analog of the reference's $unwind/$group disk-spilling
-        pipeline (server-store-mongodb/src/aggregations.rs:164-195)."""
+        pipeline (server-store-mongodb/src/aggregations.rs:164-195).
+
+        Returns a GENERATOR of columns: the snapshot pipeline enqueues
+        each clerk's job before pulling the next column, so peak memory
+        is one column (1/clerks of the cohort) — a list of columns here
+        would materialize the entire ciphertext matrix and erase the
+        point of streaming (asserted by the 100K flat-memory stress,
+        tests/test_scale_stress.py).
+
+        Streaming moves column extraction after the first jobs are
+        already enqueued, so malformed bodies must be rejected BEFORE
+        the first yield: a mid-stream failure would otherwise leave
+        clerks 0..k-1 holding durable jobs for a snapshot whose commit
+        point (create_snapshot) never runs. One indexed COUNT validates
+        every snapped body's clerk_encryptions shape up front — constant
+        memory, no early enqueue of phantom jobs. (The service layer
+        validates shape at participation creation too; this guards
+        direct store writes and corruption.)"""
+        with self.db.lock:
+            bad = self.db.conn.execute(
+                "SELECT COUNT(*) FROM snapshot_members m "
+                "JOIN participations p ON p.id = m.participation "
+                "WHERE m.snapshot = ? AND ("
+                "  json_array_length(p.body, '$.clerk_encryptions') IS NULL"
+                "  OR json_array_length(p.body, '$.clerk_encryptions') != ?)",
+                (str(snapshot_id), clerks_number),
+            ).fetchone()[0]
+        if bad:
+            raise ServerError(
+                f"snapshot {snapshot_id}: {bad} snapped participation(s) "
+                f"lack exactly {clerks_number} clerk encryptions — "
+                "refusing to enqueue a partial transpose"
+            )
 
         def column(ix: int):
             with self.db.lock:
@@ -375,7 +417,7 @@ class SqliteAggregationsStore(AggregationsStore):
                 ).fetchall()
             return [Encryption.from_json(json.loads(r[0])) for r in rows]
 
-        return [column(ix) for ix in range(clerks_number)]
+        return (column(ix) for ix in range(clerks_number))
 
     def create_snapshot_mask(self, snapshot_id, mask: list) -> None:
         self.db.execute(

@@ -15,7 +15,7 @@ disk spill; the TPU fabric does it as an all_to_all when tensor-resident).
 from __future__ import annotations
 
 import abc
-from typing import Iterator, Optional
+from typing import Iterable, Iterator, Optional
 
 from ..protocol import Labelled
 
@@ -118,12 +118,16 @@ class AggregationsStore(BaseStore):
 
     def iter_snapshot_clerk_jobs_data(
         self, aggregation_id, snapshot_id, clerks_number: int
-    ) -> list:
-        """Transpose participations x clerks -> per-clerk ciphertext lists.
+    ) -> Iterable:
+        """Transpose participations x clerks -> per-clerk ciphertext columns.
 
-        Default in-memory transpose (stores.rs:86-101); column ix is the
-        clerk's committee position (participations carry clerk encryptions
-        in committee order).
+        Contract: an ITERABLE of ``clerks_number`` columns, consumed once
+        in committee order (column ix = the clerk's committee position;
+        participations carry clerk encryptions in committee order).
+        Backends may return a lazy single-use generator (sqlite, file
+        store above its threshold) — callers must not index, len(), or
+        iterate twice. This default is the reference's eager in-memory
+        transpose (stores.rs:86-101).
         """
         shares: list = [[] for _ in range(clerks_number)]
         for participation in self.iter_snapped_participations(aggregation_id, snapshot_id):

@@ -45,17 +45,24 @@ def small_aggregation(recipient, recipient_key) -> Aggregation:
 
 
 def fake_participation(participant_id, agg_id, clerks, pi):
-    """Marker "ciphertexts": [clerk index, participant index hi, lo]."""
+    """Marker "ciphertexts": [clerk index, participant index hi/mid/lo]
+    (24-bit index: the 100K stress in test_scale_stress.py shares this)."""
     return Participation(
         id=ParticipationId.random(),
         participant=participant_id,
         aggregation=agg_id,
         recipient_encryption=None,
         clerk_encryptions=[
-            (c.id, Encryption(Binary(bytes([ci, pi >> 8, pi & 0xFF]))))
+            (c.id, Encryption(Binary(
+                bytes([ci, pi >> 16, (pi >> 8) & 0xFF, pi & 0xFF])
+            )))
             for ci, c in enumerate(clerks)
         ],
     )
+
+
+def marker_participant_index(raw: bytes) -> int:
+    return (raw[1] << 16) | (raw[2] << 8) | raw[3]
 
 
 def test_full_mocked_loop():
@@ -236,7 +243,7 @@ def test_transpose_stress_large_cohort():
             for enc in job.encryptions:
                 raw = bytes(enc.inner)
                 assert raw[0] == ci, "ciphertext routed to the wrong clerk"
-                seen.add((raw[1] << 8) | raw[2])
+                seen.add(marker_participant_index(raw))
             assert seen == set(range(n_participants)), "participants lost/dup"
 
 
@@ -283,7 +290,56 @@ def test_file_store_streaming_transpose_routes_identically(tmp_path, monkeypatch
         for enc in job.encryptions:
             raw = bytes(enc.inner)
             assert raw[0] == ci, "ciphertext routed to the wrong clerk"
-            order.append((raw[1] << 8) | raw[2])
+            order.append(marker_participant_index(raw))
         assert set(order) == set(range(n_participants)), "participants lost/dup"
         orders.append(order)
     assert all(o == orders[0] for o in orders), "columns misaligned across passes"
+
+
+def test_sqlite_transpose_rejects_malformed_body_before_enqueue():
+    """The sqlite streaming transpose yields columns lazily, AFTER the
+    snapshot pipeline starts enqueueing — so a malformed stored body
+    (possible only via direct store writes/corruption; the service
+    validates at the door) must be rejected before the first column, or
+    clerks 0..k-1 would hold durable jobs for a snapshot whose commit
+    point never runs."""
+    import json as _json
+
+    from sda_tpu.protocol import ServerError
+    from sda_tpu.server import new_sqlite_server
+
+    service = new_sqlite_server(":memory:")
+    agents = [new_full_agent(service) for _ in range(4)]
+    alice, alice_key = agents[0]
+    agg = small_aggregation(alice.id, alice_key.body.id)
+    service.create_aggregation(alice, agg)
+    clerks = service.suggest_committee(alice, agg.id)[:3]
+    service.create_committee(
+        alice,
+        Committee(aggregation=agg.id,
+                  clerks_and_keys=[(c.id, c.keys[0]) for c in clerks]),
+    )
+    p, _ = new_full_agent(service)
+    for pi in range(4):
+        service.create_participation(p, fake_participation(p.id, agg.id, clerks, pi))
+    # corrupt one stored body behind the service's back: drop a clerk column
+    store = service.server.aggregation_store
+    with store.db.lock:
+        pid, body = store.db.conn.execute(
+            "SELECT id, body FROM participations LIMIT 1"
+        ).fetchone()
+        doc = _json.loads(body)
+        doc["clerk_encryptions"] = doc["clerk_encryptions"][:2]
+        store.db.conn.execute(
+            "UPDATE participations SET body = ? WHERE id = ?",
+            (_json.dumps(doc), pid),
+        )
+        store.db.conn.commit()
+
+    snapshot = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    with pytest.raises(ServerError, match="partial transpose"):
+        service.create_snapshot(alice, snapshot)
+    # nothing was enqueued for any clerk
+    agent_by_id = {a.id: a for a, _ in agents}
+    for c in clerks:
+        assert service.get_clerking_job(agent_by_id[c.id], c.id) is None
