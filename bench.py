@@ -47,23 +47,26 @@ NORTH_STAR_ELEMS_PER_S_PER_CHIP = (1_000_000 * 100_000) / 60.0 / 8.0
 
 METRIC_NAME = "packed_shamir_secure_sum_throughput_single_chip"
 
+#: host-side crypto-plane rates, filled once by main() and attached to
+#: whichever metric line (success or error) the run emits — a wedged
+#: device must not erase the round's host-plane perf evidence
+_CRYPTO_STATS: dict = {}
+
 
 def emit_error(msg: str) -> None:
     """The contract: whatever goes wrong, stdout carries exactly one
     well-formed error-tagged metric line (never a raw traceback, never
     silence). Details go to stderr."""
-    print(
-        json.dumps(
-            {
-                "metric": METRIC_NAME,
-                "value": 0,
-                "unit": "shared_elements_per_second",
-                "vs_baseline": 0.0,
-                "error": msg,
-            }
-        ),
-        flush=True,
-    )
+    line = {
+        "metric": METRIC_NAME,
+        "value": 0,
+        "unit": "shared_elements_per_second",
+        "vs_baseline": 0.0,
+        "error": msg,
+    }
+    if _CRYPTO_STATS:
+        line["crypto"] = _CRYPTO_STATS
+    print(json.dumps(line), flush=True)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -143,6 +146,61 @@ def probe_device(timeout_s: float) -> str | None:
         flush=True,
     )
     return None
+
+
+def measure_crypto_plane() -> dict:
+    """Host-side crypto/protocol-plane rates (SURVEY hard part #5: a
+    1M x n cohort means millions of sealed boxes — CPU-bound, and the
+    reason the C plane exists). A few hundred ms total; the numbers ride
+    along in the one metric line so every bench artifact records them.
+    Batch = the C extension path (native/_sdanative.c); scalar = the
+    ctypes-per-call path the batch one replaces."""
+    import numpy as np
+
+    from sda_tpu import native
+    from sda_tpu.crypto import sodium
+
+    out = {"native_ext": native.available()}
+    pk, sk = sodium.box_keypair()
+    msg = b"\x42" * 64
+    n_seal = 2000
+
+    t0 = time.perf_counter()
+    sealed = native.seal_batch([msg] * n_seal, pk)
+    out["seals_per_s"] = round(n_seal / (time.perf_counter() - t0))
+    t0 = time.perf_counter()
+    opened = native.open_batch(sealed, pk, sk)
+    out["opens_per_s"] = round(n_seal / (time.perf_counter() - t0))
+    assert opened[0] == msg
+
+    n_scalar = 300
+    t0 = time.perf_counter()
+    for _ in range(n_scalar):
+        sodium.seal(msg, pk)
+    scalar_rate = n_scalar / (time.perf_counter() - t0)
+    out["seal_batch_vs_scalar"] = round(out["seals_per_s"] / scalar_rate, 2)
+
+    seed = np.arange(4, dtype=np.uint32)
+    dim, m = 1_000_000, (1 << 61) - 1
+    t0 = time.perf_counter()
+    native.chacha_expand(seed, dim, m)
+    out["chacha_expand_elems_per_s"] = round(dim / (time.perf_counter() - t0))
+    seeds = np.arange(64, dtype=np.uint32).reshape(16, 4)
+    t0 = time.perf_counter()
+    native.chacha_combine(seeds, 100_000, m)
+    out["chacha_combine_elems_per_s"] = round(
+        16 * 100_000 / (time.perf_counter() - t0)
+    )
+
+    vals = np.arange(-500_000, 500_000, dtype=np.int64)
+    t0 = time.perf_counter()
+    buf = native.varint_encode(vals)
+    out["varint_encode_per_s"] = round(len(vals) / (time.perf_counter() - t0))
+    t0 = time.perf_counter()
+    back = native.varint_decode(buf)
+    out["varint_decode_per_s"] = round(len(vals) / (time.perf_counter() - t0))
+    assert np.array_equal(back, vals)
+    return out
 
 
 @contextlib.contextmanager
@@ -620,12 +678,21 @@ def run(args: argparse.Namespace, watchdog) -> int:
         result["partial"] = True
     if includes_compile:
         result["includes_compile"] = True
+    if _CRYPTO_STATS:
+        result["crypto"] = _CRYPTO_STATS
     print(json.dumps(result))
     return 0
 
 
 def main() -> int:
     args = parse_args()
+    # host-plane rates first: pure CPU, independent of device health, and
+    # attached to success AND error lines (SURVEY hard part #5 evidence)
+    try:
+        with stage("crypto-plane host bench"):
+            _CRYPTO_STATS.update(measure_crypto_plane())
+    except Exception as exc:  # never let the rider break the main metric
+        print(f"[bench] crypto-plane bench failed: {exc}", file=sys.stderr)
     # fail fast on an unreachable backend: the wedged-tunnel failure mode
     # (the axon relay can block jax.devices() for hours) would otherwise
     # eat the whole --deadline before the watchdog reports it. The probe
