@@ -52,6 +52,10 @@ METRIC_NAME = "packed_shamir_secure_sum_throughput_single_chip"
 #: device must not erase the round's host-plane perf evidence
 _CRYPTO_STATS: dict = {}
 
+#: on-device parity evidence (filled after device acquisition); attached
+#: to success AND error lines so a later pipeline crash can't erase it
+_PARITY_STATS: dict = {}
+
 
 def emit_error(msg: str) -> None:
     """The contract: whatever goes wrong, stdout carries exactly one
@@ -66,6 +70,8 @@ def emit_error(msg: str) -> None:
     }
     if _CRYPTO_STATS:
         line["crypto"] = _CRYPTO_STATS
+    if _PARITY_STATS:
+        line["tpu_parity"] = _PARITY_STATS
     print(json.dumps(line), flush=True)
 
 
@@ -200,6 +206,112 @@ def measure_crypto_plane() -> dict:
     back = native.varint_decode(buf)
     out["varint_decode_per_s"] = round(len(vals) / (time.perf_counter() - t0))
     assert np.array_equal(back, vals)
+    return out
+
+
+def measure_tpu_parity() -> dict:
+    """On-device bit-parity of every accelerated plane against its host
+    oracle (VERDICT r1 #2: the Pallas/jnp device paths had only ever run
+    under the CPU interpreter). Small shapes — a few seconds of compute
+    after compiles. Each item reports ok/error independently so one
+    failure doesn't hide the others' evidence. Runs on whatever backend
+    jax initialized (the driver's TPU; CPU in the test suite, where it
+    validates the same code paths via interpret/jnp)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # write straight into the published dict: if a later item hangs and
+    # the deadline watchdog os._exit()s, the items that already finished
+    # still reach the error metric line
+    out = _PARITY_STATS
+    out["platform"] = jax.devices()[0].platform
+
+    def item(name, fn):
+        try:
+            fn()
+            out[name] = "ok"
+        except Exception as exc:  # noqa: BLE001 — per-item evidence
+            out[name] = f"FAIL {type(exc).__name__}: {exc}"
+
+    def chacha_parity():
+        from sda_tpu.ops.chacha import expand_seed
+        from sda_tpu.ops.chacha_pallas import expand_seeds_batch, pallas_available
+
+        rng = np.random.default_rng(3)
+        seeds = rng.integers(0, 2**32, size=(4, 4), dtype=np.uint32)
+        dim = 4096
+        backends = ["jnp"] + (["pallas"] if pallas_available() else [])
+        out["chacha_backends"] = backends
+        want = np.stack([expand_seed(s, dim, (1 << 61) - 1) for s in seeds])
+        for backend in backends:
+            got = np.asarray(
+                expand_seeds_batch(jnp.asarray(seeds), dim, (1 << 61) - 1,
+                                   backend=backend)
+            )
+            if not np.array_equal(got, want):
+                raise AssertionError(f"chacha {backend} != numpy oracle")
+
+    def limb_parity():
+        from sda_tpu.ops import find_packed_parameters
+        from sda_tpu.parallel.engine import make_plan, share_combine_limb
+        from sda_tpu.parallel.limb_pallas import share_combine_limb_pallas
+        from sda_tpu.protocol import PackedShamirSharing
+
+        p31, w2, w3 = find_packed_parameters(5, 2, 8, min_modulus_bits=30, seed=0)
+        plan = make_plan(PackedShamirSharing(5, 8, 2, p31, w2, w3), 40)
+        rng = np.random.default_rng(4)
+        secrets = jnp.asarray(rng.integers(0, p31, size=(64, 40)))
+        key = jax.random.key(9)
+        a = np.asarray(jax.jit(
+            lambda s, k: share_combine_limb(s, k, plan)
+        )(secrets, key))
+        b = np.asarray(jax.jit(
+            lambda s, k: share_combine_limb_pallas(s, k, plan)
+        )(secrets, key))
+        if not np.array_equal(a, b):
+            raise AssertionError("limb pallas != jnp limb path")
+
+    def wide_parity():
+        from sda_tpu.ops import find_packed_parameters
+        from sda_tpu.ops.modular import positive
+        from sda_tpu.parallel.engine import (
+            make_plan,
+            reconstruct,
+            share_combine_limb,
+        )
+        from sda_tpu.parallel.limbmatmul import limb_recombine_host
+        from sda_tpu.protocol import PackedShamirSharing
+
+        p61, w2, w3 = find_packed_parameters(5, 2, 8, min_modulus_bits=60, seed=0)
+        scheme = PackedShamirSharing(5, 8, 2, p61, w2, w3)
+        dim = 25
+        plan = make_plan(scheme, dim)
+        rng = np.random.default_rng(5)
+        secrets = (p61 - rng.integers(1, 10_000, size=(32, dim))).astype(np.int64)
+        acc = np.asarray(
+            jax.jit(lambda s, k: share_combine_limb(s, k, plan))(
+                jnp.asarray(secrets), jax.random.key(2)
+            )
+        )
+        clerk_sums = limb_recombine_host(acc, p61).T  # exact, host-side
+        got = positive(
+            np.asarray(
+                reconstruct(jnp.asarray(clerk_sums), range(8), scheme, dim)
+            ),
+            p61,
+        )
+        want = np.array(
+            [sum(int(v) for v in secrets[:, j]) % p61 for j in range(dim)],
+            dtype=np.int64,
+        )
+        if not np.array_equal(got, want):
+            raise AssertionError("wide 61-bit device aggregate != host sum")
+
+    item("chacha", chacha_parity)
+    item("limb", limb_parity)
+    item("wide61", wide_parity)
+    out["ok"] = all(out.get(k) == "ok" for k in ("chacha", "limb", "wide61"))
     return out
 
 
@@ -338,6 +450,13 @@ def parse_args() -> argparse.Namespace:
         "$SDA_BENCH_DEADLINE or 3000",
     )
     parser.add_argument(
+        "--no-parity",
+        action="store_true",
+        help="skip the on-device bit-parity checks (chacha/limb/wide61 "
+        "vs host oracles) that otherwise run once after device "
+        "acquisition and ride along in the metric line",
+    )
+    parser.add_argument(
         "--probe",
         type=float,
         default=None,
@@ -407,6 +526,14 @@ def run(args: argparse.Namespace, watchdog) -> int:
     with stage("acquire device"):
         dev = jax.devices()[0]
     print(f"device: {dev}", file=sys.stderr)
+
+    if not args.no_parity:
+        # on-silicon bit-parity of the accelerated planes vs host oracles
+        # (VERDICT r1 #2); failures are recorded per item, never fatal —
+        # the throughput measurement below is still worth taking
+        with stage("device parity checks"):
+            measure_tpu_parity()  # fills _PARITY_STATS item by item
+        print(f"[bench] parity: {_PARITY_STATS}", file=sys.stderr, flush=True)
 
     k, t, n = args.secret_count, args.privacy_threshold, args.share_count
     bits = 60 if args.wide else 30
@@ -680,6 +807,8 @@ def run(args: argparse.Namespace, watchdog) -> int:
         result["includes_compile"] = True
     if _CRYPTO_STATS:
         result["crypto"] = _CRYPTO_STATS
+    if _PARITY_STATS:
+        result["tpu_parity"] = _PARITY_STATS
     print(json.dumps(result))
     return 0
 

@@ -48,7 +48,8 @@ def _cpu_bench_env():
 def test_bench_cpu_smoke_all_engines():
     """The driver's bench entry must never rot: run every engine path at
     tiny sizes on CPU (subprocess, so the forced-cpu env doesn't leak) and
-    require the self-verification line plus a well-formed JSON metric."""
+    require the self-verification line plus a well-formed JSON metric
+    carrying the crypto-plane rates and the device parity evidence."""
     import json
     import sys
 
@@ -75,6 +76,10 @@ def test_bench_cpu_smoke_all_engines():
         line = json.loads(out.stdout.strip().splitlines()[-1])
         assert line["unit"] == "shared_elements_per_second"
         assert line["value"] > 0
+        assert line["crypto"]["seals_per_s"] > 0
+        parity = line["tpu_parity"]
+        assert parity["ok"] is True, parity
+        assert parity["chacha"] == parity["limb"] == parity["wide61"] == "ok"
 
 
 def test_bench_deadline_emits_error_metric():
