@@ -1,0 +1,27 @@
+#!/bin/sh
+# One-command TPU revalidation for a freshly healthy chip: probe cheaply,
+# then run the smoke shape and the full north-star config, saving each
+# metric line (with crypto-plane rates and on-device parity evidence)
+# under bench-artifacts/. Run from the repo root with the ambient axon env.
+#
+# Usage: sh scripts/tpu-revalidate.sh [outdir]   (default bench-artifacts)
+set -e
+cd "$(dirname "$0")/.."
+out="${1:-bench-artifacts}"
+mkdir -p "$out"
+stamp=$(date +%Y%m%d-%H%M%S)
+
+echo "[revalidate] probing device..." >&2
+if ! timeout 150 python -c "import jax; print(jax.devices())" >&2; then
+    echo "[revalidate] device unreachable; aborting (nothing written)" >&2
+    exit 2
+fi
+
+echo "[revalidate] smoke shape (--quick)..." >&2
+python bench.py --quick | tee "$out/quick-$stamp.json"
+
+echo "[revalidate] north-star shape (1M x 100K, 61-bit)..." >&2
+python bench.py | tee "$out/northstar-$stamp.json"
+
+echo "[revalidate] done; artifacts in $out/ — update README.md/docs/tpu.md" \
+     "provenance notes with these numbers" >&2
