@@ -513,3 +513,13 @@ def test_golden_signed_key_and_canonical_bytes():
     )
     pin(WF.SIGNED_ENCRYPTION_KEY, signed, signed_encryption_key_from_json)
     assert canonical_bytes(signed.body) == WF.CANONICAL_LABELLED_KEY
+
+
+def test_golden_pong():
+    """Pong — methods.rs:6-10; the one non-resource wire body."""
+    from sda_tpu.protocol import Pong
+
+    assert json.dumps(Pong(running=True).to_json(), separators=(",", ":")) == (
+        '{"running":true}'
+    )
+    assert Pong.from_json({"running": True}) == Pong(running=True)
