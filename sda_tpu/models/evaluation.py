@@ -85,8 +85,14 @@ class SecureEvaluation:
             recipient, aggregation_id, n_submitted
         )
         out = dict(zip(self.metric_names, mean["metrics"]))
-        out["examples"] = int(round(total))
+        out["examples"] = self._format_examples(total)
         return out
+
+    @staticmethod
+    def _format_examples(total: float):
+        """Policy hook: the noise-free total is an exact integer count.
+        The DP subclass keeps the noisy float instead."""
+        return int(round(total))
 
 
 class DPSecureEvaluation(SecureEvaluation):
@@ -115,18 +121,14 @@ class DPSecureEvaluation(SecureEvaluation):
             mechanism=mechanism, rng=rng,
         )
 
-    def finish(self, recipient, aggregation_id, n_submitted: int) -> dict:
-        """Like the base, but ``"examples"`` stays the noisy float — for
-        a tiny cohort it can legitimately come back <= 0 (metrics are
-        NaN then); rounding it to an int would dress noise up as an
-        exact count, and raising would waste the already-charged
-        privacy budget. The caller judges usability."""
-        mean, total = self.fed.finish_round(
-            recipient, aggregation_id, n_submitted
-        )
-        out = dict(zip(self.metric_names, mean["metrics"]))
-        out["examples"] = float(total)
-        return out
+    @staticmethod
+    def _format_examples(total: float):
+        """``"examples"`` stays the noisy float — for a tiny cohort it
+        can legitimately come back <= 0 (metrics are NaN then); rounding
+        it to an int would dress noise up as an exact count, and raising
+        would waste the already-charged privacy budget. The caller
+        judges usability."""
+        return float(total)
 
     def privacy(self, n_actual: int | None = None):
         return self.fed.privacy(n_actual)

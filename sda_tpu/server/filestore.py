@@ -219,6 +219,29 @@ class FileAggregationsStore(AggregationsStore):
     #: the one-pass in-memory default to per-clerk column scans
     TRANSPOSE_STREAM_THRESHOLD = 10_000
 
+    def validate_snapshot_clerk_jobs(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> None:
+        """Streaming cohorts only: one validation pass over the snapped
+        bodies before the pipeline enqueues anything (the eager
+        below-threshold path is safe by construction — see the base
+        docstring). Also surfaces missing payload files up front via
+        iter_snapped_participations' loud-raise, narrowing the window in
+        which a mid-column-scan disappearance could strand phantom jobs.
+        Cost: one extra directory scan on top of the ``clerks`` column
+        scans (~1/clerks overhead)."""
+        n = self.count_participations_snapshot(aggregation_id, snapshot_id)
+        if n <= self.TRANSPOSE_STREAM_THRESHOLD:
+            return
+        for p in self.iter_snapped_participations(aggregation_id, snapshot_id):
+            if len(p.clerk_encryptions) != clerks_number:
+                raise ServerError(
+                    f"snapshot {snapshot_id}: participation {p.id} has "
+                    f"{len(p.clerk_encryptions)} clerk encryptions, "
+                    f"expected {clerks_number} — refusing to enqueue a "
+                    "partial transpose"
+                )
+
     def iter_snapshot_clerk_jobs_data(
         self, aggregation_id, snapshot_id, clerks_number: int
     ):

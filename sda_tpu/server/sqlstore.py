@@ -338,24 +338,37 @@ class SqliteAggregationsStore(AggregationsStore):
             self.db.conn.commit()
 
     def iter_snapped_participations(self, aggregation_id, snapshot_id):
-        # streaming: one indexed scan, memory bounded to a fetch batch
-        # (a fetchall here would materialize every raw body for the
+        # streaming: indexed ord-range batches, memory bounded to one
+        # batch (a fetchall would materialize every raw body for the
         # whole cohort — the exact RAM ceiling this backend exists to
-        # avoid). The lock is released between batches; the frozen
-        # snapshot_members rows make the scan insensitive to concurrent
-        # participation writes.
+        # avoid). Each batch is a COMPLETE query under the lock — never
+        # an open cursor held across lock releases, whose row visibility
+        # under same-connection writes (e.g. delete_aggregation) is
+        # undefined in sqlite. ord is dense 0..n-1 at freeze time, so a
+        # short batch means rows were deleted mid-scan: raise loudly
+        # rather than silently yield a partial cohort.
+        s = str(snapshot_id)
         with self.db.lock:
-            cur = self.db.conn.execute(
-                "SELECT p.body FROM snapshot_members m "
-                "JOIN participations p ON p.id = m.participation "
-                "WHERE m.snapshot = ? ORDER BY m.ord",
-                (str(snapshot_id),),
-            )
-        while True:
+            total = self.db.conn.execute(
+                "SELECT COUNT(*) FROM snapshot_members WHERE snapshot = ?", (s,)
+            ).fetchone()[0]
+        batch = 1024
+        for lo in range(0, total, batch):
+            want = min(batch, total - lo)
             with self.db.lock:
-                rows = cur.fetchmany(1024)
-            if not rows:
-                return
+                rows = self.db.conn.execute(
+                    "SELECT p.body FROM snapshot_members m "
+                    "JOIN participations p ON p.id = m.participation "
+                    "WHERE m.snapshot = ? AND m.ord >= ? AND m.ord < ? "
+                    "ORDER BY m.ord",
+                    (s, lo, lo + batch),
+                ).fetchall()
+            if len(rows) != want:
+                raise ServerError(
+                    f"snapshot {snapshot_id}: snapped rows vanished "
+                    f"mid-scan (ord [{lo},{lo + batch}) returned "
+                    f"{len(rows)}/{want}) — store mutated during iteration?"
+                )
             for (body,) in rows:
                 yield Participation.from_json(json.loads(body))
 
@@ -365,6 +378,28 @@ class SqliteAggregationsStore(AggregationsStore):
             (str(snapshot_id),),
         )
         return row[0]
+
+    def validate_snapshot_clerk_jobs(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> None:
+        """One indexed COUNT validates every snapped body's
+        clerk_encryptions shape before the pipeline enqueues anything —
+        constant memory, no phantom jobs (see the base docstring)."""
+        with self.db.lock:
+            bad = self.db.conn.execute(
+                "SELECT COUNT(*) FROM snapshot_members m "
+                "JOIN participations p ON p.id = m.participation "
+                "WHERE m.snapshot = ? AND ("
+                "  json_array_length(p.body, '$.clerk_encryptions') IS NULL"
+                "  OR json_array_length(p.body, '$.clerk_encryptions') != ?)",
+                (str(snapshot_id), clerks_number),
+            ).fetchone()[0]
+        if bad:
+            raise ServerError(
+                f"snapshot {snapshot_id}: {bad} snapped participation(s) "
+                f"lack exactly {clerks_number} clerk encryptions — "
+                "refusing to enqueue a partial transpose"
+            )
 
     def iter_snapshot_clerk_jobs_data(
         self, aggregation_id, snapshot_id, clerks_number: int
@@ -381,30 +416,9 @@ class SqliteAggregationsStore(AggregationsStore):
         point of streaming (asserted by the 100K flat-memory stress,
         tests/test_scale_stress.py).
 
-        Streaming moves column extraction after the first jobs are
-        already enqueued, so malformed bodies must be rejected BEFORE
-        the first yield: a mid-stream failure would otherwise leave
-        clerks 0..k-1 holding durable jobs for a snapshot whose commit
-        point (create_snapshot) never runs. One indexed COUNT validates
-        every snapped body's clerk_encryptions shape up front — constant
-        memory, no early enqueue of phantom jobs. (The service layer
-        validates shape at participation creation too; this guards
-        direct store writes and corruption.)"""
-        with self.db.lock:
-            bad = self.db.conn.execute(
-                "SELECT COUNT(*) FROM snapshot_members m "
-                "JOIN participations p ON p.id = m.participation "
-                "WHERE m.snapshot = ? AND ("
-                "  json_array_length(p.body, '$.clerk_encryptions') IS NULL"
-                "  OR json_array_length(p.body, '$.clerk_encryptions') != ?)",
-                (str(snapshot_id), clerks_number),
-            ).fetchone()[0]
-        if bad:
-            raise ServerError(
-                f"snapshot {snapshot_id}: {bad} snapped participation(s) "
-                f"lack exactly {clerks_number} clerk encryptions — "
-                "refusing to enqueue a partial transpose"
-            )
+        Malformed bodies are rejected up front by
+        ``validate_snapshot_clerk_jobs`` (called by the snapshot
+        pipeline before the first yield)."""
 
         def column(ix: int):
             with self.db.lock:

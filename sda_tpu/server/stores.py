@@ -116,6 +116,23 @@ class AggregationsStore(BaseStore):
     def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
         return sum(1 for _ in self.iter_snapped_participations(aggregation_id, snapshot_id))
 
+    def validate_snapshot_clerk_jobs(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> None:
+        """Reject malformed snapped bodies BEFORE the transpose starts.
+
+        Streaming backends yield columns lazily, after the snapshot
+        pipeline has begun durably enqueueing clerk jobs — a mid-stream
+        failure would leave clerks 0..k-1 holding jobs for a snapshot
+        whose commit point never runs. The pipeline calls this first; a
+        backend whose transpose can fail mid-stream must override it to
+        raise here instead (sqlite: indexed COUNT; file store: one
+        validation pass). The default is a no-op because the base
+        transpose is eager — it materializes every column before the
+        caller sees the first one, so a malformed body raises before any
+        enqueue. (The service layer validates shape at participation
+        creation; this guards direct store writes and corruption.)"""
+
     def iter_snapshot_clerk_jobs_data(
         self, aggregation_id, snapshot_id, clerks_number: int
     ) -> Iterable:

@@ -343,3 +343,47 @@ def test_sqlite_transpose_rejects_malformed_body_before_enqueue():
     agent_by_id = {a.id: a for a, _ in agents}
     for c in clerks:
         assert service.get_clerking_job(agent_by_id[c.id], c.id) is None
+
+
+def test_file_streaming_transpose_rejects_malformed_body_before_enqueue(
+    tmp_path, monkeypatch
+):
+    """Same guarantee on the file store's streaming path (above its
+    threshold, forced to 0 here): a corrupted stored body fails the
+    snapshot up front, before any clerk job is durably enqueued."""
+    import json as _json
+    import os as _os
+
+    from sda_tpu.protocol import ServerError
+    from sda_tpu.server import new_file_server
+    from sda_tpu.server.filestore import FileAggregationsStore
+
+    monkeypatch.setattr(FileAggregationsStore, "TRANSPOSE_STREAM_THRESHOLD", 0)
+    service = new_file_server(tmp_path / "store")
+    agents = [new_full_agent(service) for _ in range(4)]
+    alice, alice_key = agents[0]
+    agg = small_aggregation(alice.id, alice_key.body.id)
+    service.create_aggregation(alice, agg)
+    clerks = service.suggest_committee(alice, agg.id)[:3]
+    service.create_committee(
+        alice,
+        Committee(aggregation=agg.id,
+                  clerks_and_keys=[(c.id, c.keys[0]) for c in clerks]),
+    )
+    p, _ = new_full_agent(service)
+    for pi in range(4):
+        service.create_participation(p, fake_participation(p.id, agg.id, clerks, pi))
+    # corrupt one payload file behind the service's back
+    store = service.server.aggregation_store
+    table = store._participations(agg.id)
+    pid = table.list_ids()[0]
+    doc = table.get(pid)
+    doc["clerk_encryptions"] = doc["clerk_encryptions"][:2]
+    table.put(pid, doc)
+
+    snapshot = Snapshot(id=SnapshotId.random(), aggregation=agg.id)
+    with pytest.raises(ServerError, match="partial transpose"):
+        service.create_snapshot(alice, snapshot)
+    agent_by_id = {a.id: a for a, _ in agents}
+    for c in clerks:
+        assert service.get_clerking_job(agent_by_id[c.id], c.id) is None
