@@ -34,6 +34,7 @@ from ..protocol import (
     AdditiveSharing,
     BasicShamirSharing,
     PackedShamirSharing,
+    SdaError,
     SodiumEncryptionScheme,
 )
 from ..rest import SdaHttpClient, TokenStore
@@ -279,7 +280,16 @@ def main(argv=None) -> int:
         service.ping()
         while True:
             log.debug("Polling for clerking job")
-            client.run_chores(-1)
+            try:
+                client.run_chores(-1)
+            except SdaError as e:
+                # a transient transport stall (REST timeout, connection
+                # reset) must not kill a long-running clerk daemon; the
+                # next poll retries. --once runs propagate: the caller
+                # asked for exactly one attempt and needs the failure.
+                if args.once:
+                    raise
+                log.warning("clerking pass failed (%s); retrying next poll", e)
             if args.once:
                 return 0
             time.sleep(args.poll_seconds)

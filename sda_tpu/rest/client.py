@@ -34,10 +34,23 @@ from ..protocol import (
 )
 
 
+#: connect + per-socket-read timeout (requests semantics: each socket
+#: operation gets this long, NOT the whole request — a server dripping
+#: bytes can still hold a connection open longer). No protocol call
+#: long-polls (get_clerking_job returns immediately), so a stalled
+#: socket is a sick server: surface it as SdaError instead of blocking
+#: indefinitely. The reference client (hyper 0.10 defaults) has no
+#: timeout; this is a deliberate hardening. Pass ``timeout=None`` to
+#: restore reference behavior.
+DEFAULT_TIMEOUT_S = 300.0
+
+
 class SdaHttpClient(SdaService):
-    def __init__(self, server_root: str, token_store):
+    def __init__(self, server_root: str, token_store,
+                 timeout: float | None = DEFAULT_TIMEOUT_S):
         self.server_root = server_root.rstrip("/")
         self.token_store = token_store
+        self.timeout = timeout
         self.session = requests.Session()
         self.session.headers["User-Agent"] = "sda-tpu client"
 
@@ -54,7 +67,16 @@ class SdaHttpClient(SdaService):
             payload = body.to_json() if hasattr(body, "to_json") else body
             data = json.dumps(payload).encode("utf-8")
             headers["Content-Type"] = "application/json"
-        resp = self.session.request(method, url, data=data, auth=auth, headers=headers)
+        try:
+            resp = self.session.request(
+                method, url, data=data, auth=auth, headers=headers,
+                timeout=self.timeout,
+            )
+        except requests.RequestException as exc:
+            # timeouts/connection failures join the documented error
+            # surface — daemon loops (e.g. `sda clerk`) catch SdaError
+            # and keep polling instead of dying on a transient stall
+            raise SdaError(f"HTTP/REST transport failure: {exc}") from exc
         return self._process(resp)
 
     @staticmethod

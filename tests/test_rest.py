@@ -239,3 +239,14 @@ def test_reference_route_table_served(http_ctx):
         assert resp.status_code in (200, 201, 204, 400, 401, 403, 404, 500), (
             method, template, resp.status_code,
         )
+
+
+def test_transport_failures_are_sda_errors(tmp_path):
+    """Timeouts/connection failures surface as SdaError — part of the
+    documented error surface daemon loops catch — never as raw requests
+    exceptions that would kill a clerk daemon."""
+    from sda_tpu.protocol import SdaError
+
+    client = SdaHttpClient("http://127.0.0.1:1", TokenStore(tmp_path), timeout=2)
+    with pytest.raises(SdaError, match="transport failure"):
+        client.ping()
