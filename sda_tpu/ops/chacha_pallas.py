@@ -198,14 +198,23 @@ def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "au
         raise SlackExhausted(
             f"seed window of {u64.shape[1]} pairs held < {dim} accepted draws"
         )
-    order = jnp.argsort(~ok, axis=1, stable=True)  # accepted first, order kept
-    u64 = jnp.take_along_axis(u64, order, axis=1)
-    return (u64 % jnp.uint64(modulus)).astype(jnp.int64)[:, :dim]
+    # stable compaction by prefix sum + scatter (linear scan; an argsort
+    # here lowers to a full sort network on TPU): accepted draw k lands
+    # in slot (#accepted before k), rejected draws scatter out of bounds
+    # and drop. Slots past the last accepted draw stay 0 but are never
+    # read — the guard above proves every row has >= dim accepted.
+    window = u64.shape[1]
+    pos = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1
+    idx = jnp.where(ok, pos, window)  # out-of-bounds marker for rejected
+    compact = jnp.zeros_like(u64).at[
+        jnp.arange(P)[:, None], idx
+    ].set(u64, mode="drop")
+    return (compact[:, :dim] % jnp.uint64(modulus)).astype(jnp.int64)
 
 
 #: transient device-memory budget per fold of combine_masks_device; the
 #: expansion materializes ~5 chunk x dim x 8 B tensors at peak (u64 pairs,
-#: rejection mask, argsort indices, gathered pairs, final masks)
+#: rejection mask, scatter indices, compacted pairs, final masks)
 _COMBINE_BYTES_BUDGET = 2 << 30
 
 
