@@ -6,7 +6,7 @@ MXU mod-p matmul.
 
 from .engine import AggregationPlan, TpuAggregator, full_training_step, make_plan
 from .mesh import make_mesh, shard_participants
-from .sumfirst import clerk_sums_sum_first
+from .sumfirst import clerk_sums_sum_first, sharded_value_limb_sums
 
 __all__ = [
     "TpuAggregator",
@@ -16,4 +16,5 @@ __all__ = [
     "make_mesh",
     "shard_participants",
     "clerk_sums_sum_first",
+    "sharded_value_limb_sums",
 ]

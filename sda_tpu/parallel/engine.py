@@ -342,13 +342,7 @@ class TpuAggregator:
     def validate_d_sharding(self, dim: int) -> None:
         """With a sharded dim axis every d-shard must hold whole batches;
         unsharded (d=1) keeps the usual zero-pad/truncate tail handling."""
-        d_size = self.mesh.shape.get("d", 1)
-        k = self.plan.input_size
-        if d_size > 1 and dim % (k * d_size) != 0:
-            raise ValueError(
-                f"dim {dim} must divide over input_size {k} x d={d_size} "
-                "so every d-shard holds whole batches"
-            )
+        validate_d_sharding(self.mesh, dim, self.plan.input_size)
 
     def sharded_limb_accumulators(self):
         """Wide-modulus sharded fabric (BASELINE config 5 is 61-bit on
@@ -416,6 +410,19 @@ class TpuAggregator:
         )
         return jax.jit(mapped)
 
+
+
+def validate_d_sharding(mesh, dim: int, input_size: int) -> None:
+    """With a sharded dim axis every d-shard zero-pads its own tail batch
+    independently — non-divisible dims would misalign batch boundaries and
+    silently reconstruct a wrong aggregate. One definition of the rule for
+    every fabric (engine, multihost, sumfirst)."""
+    d_size = mesh.shape.get("d", 1)
+    if d_size > 1 and dim % (input_size * d_size) != 0:
+        raise ValueError(
+            f"dim {dim} must divide over input_size {input_size} x d={d_size} "
+            "so every d-shard holds whole batches"
+        )
 
 
 def fold_mesh_axes(key, mesh):

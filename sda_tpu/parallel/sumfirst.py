@@ -196,3 +196,41 @@ def reconstruct_from_clerk_sums(clerk_sums, indices, scheme, dim: int):
     """Host-exact reconstruction for any modulus width (tiny inputs; the
     bench epilogue). Same helper backs ``engine.reconstruct``'s wide path."""
     return shamir.reconstruct_clerk_sums_host(clerk_sums, indices, scheme, dim)
+
+
+def sharded_value_limb_sums(plan: AggregationPlan, mesh):
+    """The sum-first hot loop over a device mesh: each device limb-sums its
+    own participant shard (``value_limb_sums_chunk``), then one int64
+    ``psum`` over the participant axis ``p`` carries only the tiny
+    ``(L, B, K)`` accumulator across ICI — the sharded twin of the
+    streaming single-chip bench loop, with the same exactness bound
+    (``MAX_PARTICIPANTS`` *total*, summed over shards, since the psum adds
+    pre-bounded per-shard limb sums).
+
+    Returns ``fn(secrets_sharded, key) -> (L, B, K)`` int64 limb sums
+    (replicated over ``p``, sharded over ``d`` on the B axis). Feed the
+    gathered result to :func:`clerk_sums_from_limb_acc` on host, exactly
+    like the single-chip chunks.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from .engine import fold_mesh_axes, validate_d_sharding
+
+    validate_d_sharding(mesh, plan.dim, plan.input_size)
+
+    def local_step(secrets, key):
+        key = fold_mesh_axes(key, mesh)
+        acc = value_limb_sums_chunk(secrets, key, plan)
+        return lax.psum(acc, axis_name="p")
+
+    d_spec = "d" if "d" in mesh.axis_names else None
+    mapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P("p", d_spec), P()),
+        out_specs=P(None, d_spec, None),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -228,6 +228,80 @@ def test_sharded_matches_engine_across_mesh_shapes(jax_mods):
         )
 
 
+def test_sharded_sum_first_fabric(jax_mods):
+    """The sum-first hot loop over the mesh: per-device limb sums + one
+    psum must reconstruct to the plaintext sum, and the accumulator's
+    verification handle must equal the batched plaintext sums."""
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import make_mesh, make_plan, shard_participants, sharded_value_limb_sums
+    from sda_tpu.parallel.engine import reconstruct
+    from sda_tpu.parallel.sumfirst import clerk_sums_from_limb_acc
+
+    p = PACKED.prime_modulus
+    dim = 24
+    P_total = 32
+    rng = np.random.default_rng(12)
+    secrets = rng.integers(0, p, size=(P_total, dim))
+    for (ps, ds) in [(8, 1), (4, 2)]:
+        mesh = make_mesh(p_size=ps, d_size=ds)
+        plan = make_plan(PACKED, dim)
+        fn = sharded_value_limb_sums(plan, mesh)
+        acc = np.asarray(fn(shard_participants(jnp.asarray(secrets), mesh), random.key(7)))
+        assert acc.shape == (1, plan.n_batches, plan.input_size + plan.rand_size)
+        clerk, vsum = clerk_sums_from_limb_acc(acc, plan)
+        out = reconstruct(jnp.asarray(clerk), range(PACKED.share_count), PACKED, dim)
+        np.testing.assert_array_equal(positive(np.asarray(out), p), _plain_sum(secrets, p))
+        np.testing.assert_array_equal(
+            vsum[:, : plan.input_size],
+            _plain_sum(secrets, p).reshape(plan.n_batches, plan.input_size),
+        )
+
+
+def test_sharded_sum_first_rejects_nondivisible_dim(jax_mods):
+    """dim not divisible by input_size*d_size must be a loud error — each
+    d-shard pads its own tail independently, silently corrupting batches."""
+    from sda_tpu.parallel import make_mesh, make_plan, sharded_value_limb_sums
+
+    mesh = make_mesh(p_size=4, d_size=2)
+    plan = make_plan(PACKED, 26)  # 26 % (3*2) != 0
+    with pytest.raises(ValueError, match="divide over input_size"):
+        sharded_value_limb_sums(plan, mesh)
+
+
+def test_sharded_sum_first_wide_modulus(jax_mods):
+    """Sum-first on the mesh at 61-bit width: the two-limb exact path
+    (no int64 overflow, no mod on device) through the same psum fabric."""
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.ops import find_packed_parameters
+    from sda_tpu.parallel import make_mesh, make_plan, shard_participants, sharded_value_limb_sums
+    from sda_tpu.parallel.sumfirst import clerk_sums_from_limb_acc, reconstruct_from_clerk_sums
+
+    pw, w2, w3 = find_packed_parameters(3, 4, 8, min_modulus_bits=60, seed=1)
+    scheme = PackedShamirSharing(3, 8, 4, pw, w2, w3)
+    dim = 12
+    P_total = 16
+    rng = np.random.default_rng(13)
+    secrets = rng.integers(pw - 50_000, pw, size=(P_total, dim)).astype(np.int64)
+    mesh = make_mesh(p_size=4, d_size=2)
+    plan = make_plan(scheme, dim)
+    acc = np.asarray(
+        sharded_value_limb_sums(plan, mesh)(
+            shard_participants(jnp.asarray(secrets), mesh), random.key(8)
+        )
+    )
+    assert acc.shape[0] == 2  # two base-2^32 limbs at 61 bits
+    clerk, vsum = clerk_sums_from_limb_acc(acc, plan)
+    out = reconstruct_from_clerk_sums(clerk, range(8), scheme, dim)
+    want = np.array(
+        [sum(int(v) for v in secrets[:, j]) % pw for j in range(dim)], dtype=np.int64
+    )
+    np.testing.assert_array_equal(positive(np.asarray(out), pw), want)
+
+
 def test_basic_shamir_engine_end_to_end():
     """BasicShamir through the TPU engine: secure_sum over a 30-bit prime
     with reconstruction from a dropped-clerk subset."""
