@@ -53,8 +53,11 @@ def make_hybrid_mesh(
     Under ``jax.distributed`` with multiple processes, uses
     ``mesh_utils.create_hybrid_device_mesh`` so ``h`` is laid out across
     slices and ``p``/``d`` within them (those collectives ride ICI).
+    There ``h_size`` is *derived* from the topology (the slice count on
+    multi-slice pods, else the process count); passing it explicitly is
+    only a cross-check — a value that miscounts the granule raises.
     Single-process (tests, dry runs): plain reshape of local devices —
-    same program, simulated topology.
+    same program, simulated topology — and ``h_size`` is free.
     """
     import jax
     import numpy as np
@@ -72,7 +75,21 @@ def make_hybrid_mesh(
         # count the same granules the mesh builder will group by.
         slice_ids = {getattr(d, "slice_index", None) for d in devices}
         by_process = (None in slice_ids) or len(slice_ids) == 1
-        h_size = h_size or (n_proc if by_process else len(slice_ids))
+        granules = n_proc if by_process else len(slice_ids)
+        if h_size is not None and h_size != granules:
+            # the mesh builder groups devices by granule (process or
+            # slice); an h_size counting the wrong unit — e.g. processes
+            # on a multi-slice pod where the DCN unit is the slice —
+            # would otherwise surface as an opaque reshape error deep in
+            # create_hybrid_device_mesh
+            unit = "process" if by_process else "slice"
+            raise ValueError(
+                f"h_size {h_size} != {granules} DCN granules: the outer "
+                f"mesh axis is laid out per {unit} on this topology, so "
+                f"h_size must equal the {unit} count ({granules}); omit "
+                "h_size to use it"
+            )
+        h_size = granules
         p_size = p_size or (len(devices) // (h_size * d_size))
         grid = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(1, p_size, d_size),

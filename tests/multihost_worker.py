@@ -49,6 +49,16 @@ def main() -> int:
     mesh = make_hybrid_mesh()  # h = process count, p = chips per process
     assert mesh.shape["h"] == nprocs, mesh.shape
 
+    # an explicit h_size that miscounts the DCN granule must be a targeted
+    # error naming the granule unit, not a reshape failure inside the mesh
+    # builder (multi-process branch only — single-process reshapes freely)
+    try:
+        make_hybrid_mesh(h_size=nprocs * 2)
+    except ValueError as e:
+        assert "DCN granules" in str(e), e
+    else:
+        raise AssertionError("wrong explicit h_size did not raise")
+
     # every process holds the same global array (same seed); device_put
     # splits it across the global mesh, each process keeping its shards
     rng = np.random.default_rng(7)
