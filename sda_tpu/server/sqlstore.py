@@ -12,6 +12,19 @@ stores.iter_snapshot_clerk_jobs_data).
 
 Job documents carry a ``done`` flag instead of queue-file moves, matching
 the mongo store's shape (clerking_jobs.rs:36-76).
+
+Multi-process sharing: like the reference's mongo backend — where any
+number of server processes serve one datastore (server-store-mongodb/
+src/lib.rs:64-84, unique-index upsert Daos at lib.rs:86-151) — one
+sqlite file may back several ``sdad`` processes at once. WAL keeps
+readers unblocked by the (single) writer, ``busy_timeout`` turns
+cross-process write contention into bounded waiting instead of
+``database is locked`` errors, and every check-then-act sequence runs
+inside ``BEGIN IMMEDIATE`` so the read half of a read-modify-write
+holds the write lock — two processes racing create-if-identical or the
+job-done flip serialize instead of interleaving. Verified end-to-end
+by tests/test_shared_store.py (two REST server processes, one file,
+full protocol + contention).
 """
 
 from __future__ import annotations
@@ -20,6 +33,7 @@ import json
 import os
 import sqlite3
 import threading
+from contextlib import contextmanager
 from typing import Optional
 
 from ..protocol import (
@@ -73,39 +87,97 @@ CREATE INDEX IF NOT EXISTS results_snapshot ON results (snapshot);
 """
 
 
+#: cross-process write-contention wait bound (seconds). Long enough to
+#: ride out another process's streaming transpose commit; short enough
+#: that a wedged writer surfaces as an error rather than a silent hang.
+BUSY_TIMEOUT_S = 30.0
+
+
 class SqliteBackend:
-    """Shared connection + lock for all four stores over one database."""
+    """Shared connection + lock for all four stores over one database.
+
+    ``self.lock`` serializes *threads* of one process on the shared
+    connection; ``transaction()`` (BEGIN IMMEDIATE) serializes
+    *processes* on the shared file — both are needed: the thread lock
+    cannot see other processes, and sqlite's write lock cannot protect
+    a Python check-then-act unless the check runs inside an immediate
+    transaction.
+    """
 
     def __init__(self, path):
         path = str(path)
         if path != ":memory:":
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.conn = sqlite3.connect(path, check_same_thread=False)
+
+        def connect():
+            # autocommit mode: transaction boundaries are explicit (BEGIN
+            # IMMEDIATE in transaction()); Python's implicit deferred
+            # transactions would take the write lock only at the first
+            # write, after the check half of check-then-act already ran.
+            # timeout=0 so the PRAGMA below is the one place the busy
+            # wait is configured.
+            conn = sqlite3.connect(
+                path, check_same_thread=False, timeout=0, isolation_level=None
+            )
+            conn.execute(f"PRAGMA busy_timeout={int(BUSY_TIMEOUT_S * 1000)}")
+            conn.execute("PRAGMA journal_mode=WAL")
+            return conn
+
+        self.conn = connect()
         self.lock = threading.RLock()
         with self.lock:
             self.conn.executescript(_SCHEMA)
-            self.conn.execute("PRAGMA journal_mode=WAL")
-            self.conn.commit()
+        # reads go through their own connection + lock: WAL lets readers
+        # run concurrently with the (single) writer, so a thread stuck in
+        # BEGIN IMMEDIATE's busy wait on another process must not stall
+        # this process's polls/status reads behind self.lock. ":memory:"
+        # has no shared file — a second connection would be a different
+        # database — so reads alias the write connection there.
+        if path == ":memory:":
+            self.read_conn, self.read_lock = self.conn, self.lock
+        else:
+            self.read_conn, self.read_lock = connect(), threading.RLock()
+
+    @contextmanager
+    def transaction(self):
+        """Thread lock + BEGIN IMMEDIATE: the write lock is taken up
+        front, so reads inside the block see a state no other process
+        can change before our writes commit."""
+        with self.lock:
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                yield self.conn
+                self.conn.execute("COMMIT")
+            except BaseException:
+                # a failed COMMIT must roll back too, or the shared
+                # connection stays inside a dead transaction and every
+                # later BEGIN fails ("cannot start a transaction within
+                # a transaction"). Guarded: some COMMIT failures
+                # (SQLITE_FULL/IOERR) auto-roll-back, and a bare
+                # ROLLBACK there would mask the real error
+                if self.conn.in_transaction:
+                    self.conn.execute("ROLLBACK")
+                raise
 
     def execute(self, sql, params=()):
         with self.lock:
-            cur = self.conn.execute(sql, params)
-            self.conn.commit()
-            return cur
+            # single-statement writes are atomic on their own; autocommit
+            # applies them immediately (no explicit transaction needed)
+            return self.conn.execute(sql, params)
 
     def query_one(self, sql, params=()):
-        with self.lock:
-            row = self.conn.execute(sql, params).fetchone()
+        with self.read_lock:
+            row = self.read_conn.execute(sql, params).fetchone()
         return row
 
     def query_all(self, sql, params=()):
-        with self.lock:
-            return self.conn.execute(sql, params).fetchall()
+        with self.read_lock:
+            return self.read_conn.execute(sql, params).fetchall()
 
     def create_row(self, table, id_col, id_val, cols: dict):
         """create-if-identical semantics via INSERT OR conflict check."""
-        with self.lock:
-            row = self.conn.execute(
+        with self.transaction() as conn:
+            row = conn.execute(
                 f"SELECT body FROM {table} WHERE {id_col} = ?", (id_val,)
             ).fetchone()
             if row is not None:
@@ -114,11 +186,10 @@ class SqliteBackend:
                 return
             names = ", ".join([id_col] + list(cols))
             marks = ", ".join("?" * (1 + len(cols)))
-            self.conn.execute(
+            conn.execute(
                 f"INSERT INTO {table} ({names}) VALUES ({marks})",
                 (id_val, *cols.values()),
             )
-            self.conn.commit()
 
 
 class SqliteAuthTokensStore(AuthTokensStore):
@@ -133,16 +204,15 @@ class SqliteAuthTokensStore(AuthTokensStore):
         )
 
     def register_auth_token(self, token) -> bool:
-        with self.db.lock:
-            row = self.db.conn.execute(
+        with self.db.transaction() as conn:
+            row = conn.execute(
                 "SELECT token FROM auth_tokens WHERE agent = ?", (str(token.id),)
             ).fetchone()
             if row is None:
-                self.db.conn.execute(
+                conn.execute(
                     "INSERT INTO auth_tokens (agent, token) VALUES (?, ?)",
                     (str(token.id), token.body),
                 )
-                self.db.conn.commit()
                 return True
             return row[0] == token.body
 
@@ -246,21 +316,20 @@ class SqliteAggregationsStore(AggregationsStore):
 
     def delete_aggregation(self, aggregation_id) -> None:
         a = str(aggregation_id)
-        with self.db.lock:
+        with self.db.transaction() as conn:
             snaps = [
                 r[0]
-                for r in self.db.conn.execute(
+                for r in conn.execute(
                     "SELECT id FROM snapshots WHERE aggregation = ?", (a,)
-                )
+                ).fetchall()
             ]
             for s in snaps:
-                self.db.conn.execute("DELETE FROM snapshot_members WHERE snapshot = ?", (s,))
-                self.db.conn.execute("DELETE FROM snapshot_masks WHERE snapshot = ?", (s,))
-            self.db.conn.execute("DELETE FROM snapshots WHERE aggregation = ?", (a,))
-            self.db.conn.execute("DELETE FROM participations WHERE aggregation = ?", (a,))
-            self.db.conn.execute("DELETE FROM committees WHERE aggregation = ?", (a,))
-            self.db.conn.execute("DELETE FROM aggregations WHERE id = ?", (a,))
-            self.db.conn.commit()
+                conn.execute("DELETE FROM snapshot_members WHERE snapshot = ?", (s,))
+                conn.execute("DELETE FROM snapshot_masks WHERE snapshot = ?", (s,))
+            conn.execute("DELETE FROM snapshots WHERE aggregation = ?", (a,))
+            conn.execute("DELETE FROM participations WHERE aggregation = ?", (a,))
+            conn.execute("DELETE FROM committees WHERE aggregation = ?", (a,))
+            conn.execute("DELETE FROM aggregations WHERE id = ?", (a,))
 
     def get_committee(self, aggregation_id):
         row = self.db.query_one(
@@ -277,6 +346,10 @@ class SqliteAggregationsStore(AggregationsStore):
         )
 
     def create_participation(self, participation) -> None:
+        # existence check + insert are NOT one transaction: a concurrent
+        # delete_aggregation can strand this row, which the snapshot
+        # freeze scopes out (it selects by aggregation id); matching the
+        # reference's non-transactional Mongo Daos
         if self.get_aggregation(participation.aggregation) is None:
             raise InvalidRequestError(f"no aggregation {participation.aggregation}")
         self.db.create_row(
@@ -323,46 +396,44 @@ class SqliteAggregationsStore(AggregationsStore):
 
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         s = str(snapshot_id)
-        with self.db.lock:
-            existing = self.db.conn.execute(
+        with self.db.transaction() as conn:
+            existing = conn.execute(
                 "SELECT COUNT(*) FROM snapshot_members WHERE snapshot = ?", (s,)
             ).fetchone()[0]
             if existing:
                 return  # write-once freeze (retry safety)
-            self.db.conn.execute(
+            conn.execute(
                 "INSERT INTO snapshot_members (snapshot, ord, participation) "
                 "SELECT ?, ROW_NUMBER() OVER (ORDER BY id) - 1, id "
                 "FROM participations WHERE aggregation = ?",
                 (s, str(aggregation_id)),
             )
-            self.db.conn.commit()
 
     def iter_snapped_participations(self, aggregation_id, snapshot_id):
         # streaming: indexed ord-range batches, memory bounded to one
         # batch (a fetchall would materialize every raw body for the
         # whole cohort — the exact RAM ceiling this backend exists to
-        # avoid). Each batch is a COMPLETE query under the lock — never
-        # an open cursor held across lock releases, whose row visibility
-        # under same-connection writes (e.g. delete_aggregation) is
-        # undefined in sqlite. ord is dense 0..n-1 at freeze time, so a
-        # short batch means rows were deleted mid-scan: raise loudly
-        # rather than silently yield a partial cohort.
+        # avoid). Each batch is a COMPLETE query on the read connection —
+        # never an open cursor held across lock releases, whose row
+        # visibility under same-connection writes (e.g.
+        # delete_aggregation) is undefined in sqlite. ord is dense
+        # 0..n-1 at freeze time, so a short batch means rows were
+        # deleted mid-scan: raise loudly rather than silently yield a
+        # partial cohort.
         s = str(snapshot_id)
-        with self.db.lock:
-            total = self.db.conn.execute(
-                "SELECT COUNT(*) FROM snapshot_members WHERE snapshot = ?", (s,)
-            ).fetchone()[0]
+        total = self.db.query_one(
+            "SELECT COUNT(*) FROM snapshot_members WHERE snapshot = ?", (s,)
+        )[0]
         batch = 1024
         for lo in range(0, total, batch):
             want = min(batch, total - lo)
-            with self.db.lock:
-                rows = self.db.conn.execute(
-                    "SELECT p.body FROM snapshot_members m "
-                    "JOIN participations p ON p.id = m.participation "
-                    "WHERE m.snapshot = ? AND m.ord >= ? AND m.ord < ? "
-                    "ORDER BY m.ord",
-                    (s, lo, lo + batch),
-                ).fetchall()
+            rows = self.db.query_all(
+                "SELECT p.body FROM snapshot_members m "
+                "JOIN participations p ON p.id = m.participation "
+                "WHERE m.snapshot = ? AND m.ord >= ? AND m.ord < ? "
+                "ORDER BY m.ord",
+                (s, lo, lo + batch),
+            )
             if len(rows) != want:
                 raise ServerError(
                     f"snapshot {snapshot_id}: snapped rows vanished "
@@ -385,15 +456,14 @@ class SqliteAggregationsStore(AggregationsStore):
         """One indexed COUNT validates every snapped body's
         clerk_encryptions shape before the pipeline enqueues anything —
         constant memory, no phantom jobs (see the base docstring)."""
-        with self.db.lock:
-            bad = self.db.conn.execute(
-                "SELECT COUNT(*) FROM snapshot_members m "
-                "JOIN participations p ON p.id = m.participation "
-                "WHERE m.snapshot = ? AND ("
-                "  json_array_length(p.body, '$.clerk_encryptions') IS NULL"
-                "  OR json_array_length(p.body, '$.clerk_encryptions') != ?)",
-                (str(snapshot_id), clerks_number),
-            ).fetchone()[0]
+        bad = self.db.query_one(
+            "SELECT COUNT(*) FROM snapshot_members m "
+            "JOIN participations p ON p.id = m.participation "
+            "WHERE m.snapshot = ? AND ("
+            "  json_array_length(p.body, '$.clerk_encryptions') IS NULL"
+            "  OR json_array_length(p.body, '$.clerk_encryptions') != ?)",
+            (str(snapshot_id), clerks_number),
+        )[0]
         if bad:
             raise ServerError(
                 f"snapshot {snapshot_id}: {bad} snapped participation(s) "
@@ -421,14 +491,13 @@ class SqliteAggregationsStore(AggregationsStore):
         pipeline before the first yield)."""
 
         def column(ix: int):
-            with self.db.lock:
-                rows = self.db.conn.execute(
-                    "SELECT json_extract(p.body, '$.clerk_encryptions[' || ? || '][1]') "
-                    "FROM snapshot_members m "
-                    "JOIN participations p ON p.id = m.participation "
-                    "WHERE m.snapshot = ? ORDER BY m.ord",
-                    (ix, str(snapshot_id)),
-                ).fetchall()
+            rows = self.db.query_all(
+                "SELECT json_extract(p.body, '$.clerk_encryptions[' || ? || '][1]') "
+                "FROM snapshot_members m "
+                "JOIN participations p ON p.id = m.participation "
+                "WHERE m.snapshot = ? ORDER BY m.ord",
+                (ix, str(snapshot_id)),
+            )
             return [Encryption.from_json(json.loads(r[0])) for r in rows]
 
         return (column(ix) for ix in range(clerks_number))
@@ -454,17 +523,16 @@ class SqliteClerkingJobsStore(ClerkingJobsStore):
         self.db = backend
 
     def enqueue_clerking_job(self, job) -> None:
-        with self.db.lock:
-            row = self.db.conn.execute(
+        with self.db.transaction() as conn:
+            row = conn.execute(
                 "SELECT id FROM jobs WHERE id = ?", (str(job.id),)
             ).fetchone()
             if row is not None:
                 return  # idempotent under deterministic snapshot retries
-            self.db.conn.execute(
+            conn.execute(
                 "INSERT INTO jobs (id, clerk, snapshot, done, body) VALUES (?, ?, ?, 0, ?)",
                 (str(job.id), str(job.clerk), str(job.snapshot), json.dumps(job.to_json())),
             )
-            self.db.conn.commit()
 
     def poll_clerking_job(self, clerk_id):
         row = self.db.query_one(
@@ -481,21 +549,20 @@ class SqliteClerkingJobsStore(ClerkingJobsStore):
         return None if row is None else ClerkingJob.from_json(json.loads(row[0]))
 
     def create_clerking_result(self, result) -> None:
-        with self.db.lock:
-            row = self.db.conn.execute(
+        with self.db.transaction() as conn:
+            row = conn.execute(
                 "SELECT snapshot FROM jobs WHERE id = ?", (str(result.job),)
             ).fetchone()
             if row is None:
                 raise InvalidRequestError(f"no job {result.job}")
-            self.db.conn.execute(
+            conn.execute(
                 "INSERT INTO results (job, snapshot, body) VALUES (?, ?, ?) "
                 "ON CONFLICT(job) DO UPDATE SET body = excluded.body",
                 (str(result.job), row[0], json.dumps(result.to_json())),
             )
-            self.db.conn.execute(
+            conn.execute(
                 "UPDATE jobs SET done = 1 WHERE id = ?", (str(result.job),)
             )
-            self.db.conn.commit()
 
     def list_results(self, snapshot_id) -> list:
         rows = self.db.query_all(

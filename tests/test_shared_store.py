@@ -1,0 +1,247 @@
+"""Two server PROCESSES, one sqlite datastore — the multi-process
+production-store deployment the reference gets from its MongoDB backend
+(server-store-mongodb/src/lib.rs:64-84: any number of server processes
+over one database).
+
+Two real ``sdad`` subprocesses serve the same sqlite file over REST; the
+full protocol runs with its roles split across them (recipient on server
+A, clerks on server B, participants alternating), so every cross-role
+handoff — committee election, participation, snapshot transpose, job
+queues, results, reveal — crosses the process boundary through the
+shared store. A second test drives concurrent participation uploads
+through both processes at once to exercise cross-process write
+contention (WAL + busy_timeout + BEGIN IMMEDIATE, sqlstore.py).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    SodiumEncryptionScheme,
+)
+
+DIM = 8
+MODULUS = 433
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_ready(port: int, proc, deadline_s: float = 30.0) -> None:
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if proc.poll() is not None:
+            raise RuntimeError(f"sdad exited rc={proc.returncode}")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/ping", timeout=2
+            ) as resp:
+                if resp.status == 200:
+                    return
+        except OSError:
+            time.sleep(0.1)
+    raise RuntimeError(f"sdad on :{port} not ready after {deadline_s}s")
+
+
+@pytest.fixture()
+def two_servers(tmp_path):
+    """Two sdad subprocesses over one sqlite file; yields their base URLs."""
+    db = tmp_path / "shared.db"
+    ports = [_free_port(), _free_port()]
+    procs = []
+    try:
+        for port in ports:
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-m",
+                        "sda_tpu.cli.sdad",
+                        "--sqlite",
+                        str(db),
+                        "httpd",
+                        "-b",
+                        f"127.0.0.1:{port}",
+                    ],
+                    cwd=REPO_ROOT,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            )
+        for port, proc in zip(ports, procs):
+            _wait_ready(port, proc)
+        yield [f"http://127.0.0.1:{p}" for p in ports]
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+def _http_client(tmpdir, base_url):
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.tokenstore import TokenStore
+
+    tmpdir.mkdir(parents=True, exist_ok=True)
+    return SdaHttpClient(base_url, TokenStore(str(tmpdir)))
+
+
+def test_full_round_across_two_server_processes(tmp_path, two_servers):
+    url_a, url_b = two_servers
+
+    # recipient lives on server A
+    recipient = new_client(tmp_path / "recipient", _http_client(tmp_path / "ta", url_a))
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+
+    # clerks live on server B
+    clerks = [
+        new_client(tmp_path / f"clerk{i}", _http_client(tmp_path / f"tb{i}", url_b))
+        for i in range(3)
+    ]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="shared-store",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(modulus=MODULUS, dimension=DIM, seed_bitsize=128),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=MODULUS),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id)
+
+    # participants alternate between the two processes
+    rng = np.random.default_rng(21)
+    vectors = rng.integers(0, MODULUS, size=(4, DIM))
+    for i in range(4):
+        url = [url_a, url_b][i % 2]
+        part = new_client(tmp_path / f"part{i}", _http_client(tmp_path / f"tp{i}", url))
+        part.upload_agent()
+        part.participate(vectors[i].tolist(), agg.id)
+
+    recipient.end_aggregation(agg.id)
+
+    # chores run against server B; recipient (a possible committee member)
+    # runs its own against server A
+    recipient.run_chores(-1)
+    for clerk in clerks:
+        clerk.run_chores(-1)
+
+    status = recipient.service.get_aggregation_status(recipient.agent, agg.id)
+    assert status.number_of_participations == 4
+    assert status.snapshots[0].result_ready
+
+    output = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(
+        output.positive().values, vectors.sum(axis=0) % MODULUS
+    )
+
+
+def test_concurrent_participations_across_processes(tmp_path, two_servers):
+    """N threads post participations through BOTH processes at once; the
+    store must keep every row (no lost updates, no 'database is locked')."""
+    url_a, url_b = two_servers
+
+    recipient = new_client(tmp_path / "recipient", _http_client(tmp_path / "ta", url_a))
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    clerks = [
+        new_client(tmp_path / f"clerk{i}", _http_client(tmp_path / f"tb{i}", url_b))
+        for i in range(3)
+    ]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="contention",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(modulus=MODULUS, dimension=DIM, seed_bitsize=128),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=MODULUS),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id)
+
+    n_parts = 12
+    rng = np.random.default_rng(22)
+    vectors = rng.integers(0, MODULUS, size=(n_parts, DIM))
+    # pre-build clients serially (keystore setup is local), post concurrently
+    participants = [
+        new_client(
+            tmp_path / f"part{i}",
+            _http_client(tmp_path / f"tp{i}", [url_a, url_b][i % 2]),
+        )
+        for i in range(n_parts)
+    ]
+    for part in participants:
+        part.upload_agent()
+
+    errors: list = []
+
+    def post(i: int) -> None:
+        try:
+            participants[i].participate(vectors[i].tolist(), agg.id)
+        except Exception as e:  # collected, not raised: join first
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(n_parts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    recipient.end_aggregation(agg.id)
+    recipient.run_chores(-1)
+    for clerk in clerks:
+        clerk.run_chores(-1)
+    status = recipient.service.get_aggregation_status(recipient.agent, agg.id)
+    assert status.number_of_participations == n_parts
+    output = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(
+        output.positive().values, vectors.sum(axis=0) % MODULUS
+    )
