@@ -65,7 +65,8 @@ class SdaHttpClient(SdaService):
         headers = {}
         if body is not None:
             payload = body.to_json() if hasattr(body, "to_json") else body
-            data = json.dumps(payload).encode("utf-8")
+            # compact, like the reference client's serde_json bodies
+            data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
             headers["Content-Type"] = "application/json"
         try:
             resp = self.session.request(

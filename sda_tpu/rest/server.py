@@ -146,7 +146,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, headers=[("Resource-not-found", "true")])
         else:
             payload = obj.to_json() if hasattr(obj, "to_json") else obj
-            self._send(200, json.dumps(payload).encode("utf-8"))
+            # compact separators: the reference emits serde_json::to_string
+            # (no whitespace, server-http/src/lib.rs:338-343); replay-interop
+            # asserts response bodies byte-identical to that shape
+            self._send(
+                200, json.dumps(payload, separators=(",", ":")).encode("utf-8")
+            )
 
     def _dispatch(self, method: str):
         path, _, query = self.path.partition("?")

@@ -227,8 +227,11 @@ class MemClerkingJobsStore(ClerkingJobsStore):
             self._queues[job.clerk] = [j for j in queue if j.id != job.id]
 
     def list_results(self, snapshot_id) -> list:
+        # job-id order: every store returns the same canonical ordering
+        # (sqlite's ORDER BY job), so snapshot-result bodies are
+        # byte-stable across backends (asserted by test_replay_interop)
         with self._lock:
-            return list(self._results.get(snapshot_id, {}).keys())
+            return sorted(self._results.get(snapshot_id, {}).keys(), key=str)
 
     def get_result(self, snapshot_id, job_id):
         with self._lock:

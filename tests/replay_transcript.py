@@ -1,0 +1,544 @@
+"""The frozen HTTP transcript of the reference walkthrough scenario.
+
+A wire-level recording of ``docs/simple-cli-example.sh`` (the reference's
+acceptance walkthrough: recipient + 3 clerks with keys, 3 keyless
+participants, additive-3 committee over modulus 433, "aggro", dim 10 —
+/root/reference/docs/simple-cli-example.sh) against the REST binding, with
+every input pinned: fixed agent/key/aggregation/participation/snapshot ids,
+fixed TOFU tokens, fixed opaque ciphertext blobs (the coordination plane
+never decrypts), and the deterministic uuid5 clerking-job ids
+(server/snapshot.py). Every JSON body is in the serde field order the
+reference emits (server-http/src/lib.rs:338-343 ``serde_json::to_string``;
+shapes pinned byte-for-byte by tests/wire_fixtures.py), compact separators.
+
+Regenerate only deliberately: test_replay_interop.py asserts the live
+server reproduces these bytes EXACTLY — any diff here is a wire break a
+reference client would feel. Riders included in the flow: 403 for a
+non-recipient reading status, 401 for a wrong token, and the
+``Resource-not-found: true`` 404 discipline for empty polls and deleted
+resources.
+
+Each step: method, path, auth (agent id + TOFU password or None),
+request_body (compact JSON string or None), expected status,
+expected Resource-not-found header value, expected response_body bytes.
+"""
+
+TRANSCRIPT = [
+ {
+  "label": "ping",
+  "method": "GET",
+  "path": "/v1/ping",
+  "auth": None,
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"running\":true}"
+ },
+ {
+  "label": "create recipient",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000001\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000001\",\"body\":{\"Sodium\":\"AQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQE=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "create clerk-1",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000002",
+   "t0k3n-2"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000002\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000002\",\"body\":{\"Sodium\":\"AgICAgICAgICAgICAgICAgICAgICAgICAgICAgICAgI=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "create clerk-2",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000003",
+   "t0k3n-3"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000003\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000003\",\"body\":{\"Sodium\":\"AwMDAwMDAwMDAwMDAwMDAwMDAwMDAwMDAwMDAwMDAwM=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "create clerk-3",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000004",
+   "t0k3n-4"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000004\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000004\",\"body\":{\"Sodium\":\"BAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQ=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "recipient key",
+  "method": "POST",
+  "path": "/v1/agents/me/keys",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": "{\"signature\":{\"Sodium\":\"AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA==\"},\"signer\":\"00000000-0000-4000-8000-000000000001\",\"body\":{\"id\":\"00000000-0000-4000-a000-000000000001\",\"body\":{\"Sodium\":\"oaGhoaGhoaGhoaGhoaGhoaGhoaGhoaGhoaGhoaGhoaE=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "clerk-1 key",
+  "method": "POST",
+  "path": "/v1/agents/me/keys",
+  "auth": [
+   "00000000-0000-4000-8000-000000000002",
+   "t0k3n-2"
+  ],
+  "request_body": "{\"signature\":{\"Sodium\":\"AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA==\"},\"signer\":\"00000000-0000-4000-8000-000000000002\",\"body\":{\"id\":\"00000000-0000-4000-a000-000000000002\",\"body\":{\"Sodium\":\"oqKioqKioqKioqKioqKioqKioqKioqKioqKioqKioqI=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "clerk-2 key",
+  "method": "POST",
+  "path": "/v1/agents/me/keys",
+  "auth": [
+   "00000000-0000-4000-8000-000000000003",
+   "t0k3n-3"
+  ],
+  "request_body": "{\"signature\":{\"Sodium\":\"AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA==\"},\"signer\":\"00000000-0000-4000-8000-000000000003\",\"body\":{\"id\":\"00000000-0000-4000-a000-000000000003\",\"body\":{\"Sodium\":\"o6Ojo6Ojo6Ojo6Ojo6Ojo6Ojo6Ojo6Ojo6Ojo6Ojo6M=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "clerk-3 key",
+  "method": "POST",
+  "path": "/v1/agents/me/keys",
+  "auth": [
+   "00000000-0000-4000-8000-000000000004",
+   "t0k3n-4"
+  ],
+  "request_body": "{\"signature\":{\"Sodium\":\"AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA==\"},\"signer\":\"00000000-0000-4000-8000-000000000004\",\"body\":{\"id\":\"00000000-0000-4000-a000-000000000004\",\"body\":{\"Sodium\":\"pKSkpKSkpKSkpKSkpKSkpKSkpKSkpKSkpKSkpKSkpKQ=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "create part-1",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000011",
+   "t0k3n-5"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000011\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000005\",\"body\":{\"Sodium\":\"AQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQEBAQE=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "create part-2",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000012",
+   "t0k3n-6"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000012\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000006\",\"body\":{\"Sodium\":\"AgICAgICAgICAgICAgICAgICAgICAgICAgICAgICAgI=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "create part-3",
+  "method": "POST",
+  "path": "/v1/agents/me",
+  "auth": [
+   "00000000-0000-4000-8000-000000000013",
+   "t0k3n-7"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000013\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000007\",\"body\":{\"Sodium\":\"AwMDAwMDAwMDAwMDAwMDAwMDAwMDAwMDAwMDAwMDAwM=\"}}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "get clerk-1 agent",
+  "method": "GET",
+  "path": "/v1/agents/00000000-0000-4000-8000-000000000002",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"id\":\"00000000-0000-4000-8000-000000000002\",\"verification_key\":{\"id\":\"00000000-0000-4000-9000-000000000002\",\"body\":{\"Sodium\":\"AgICAgICAgICAgICAgICAgICAgICAgICAgICAgICAgI=\"}}}"
+ },
+ {
+  "label": "get clerk-1 key",
+  "method": "GET",
+  "path": "/v1/agents/any/keys/00000000-0000-4000-a000-000000000002",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"signature\":{\"Sodium\":\"AAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAAA==\"},\"signer\":\"00000000-0000-4000-8000-000000000002\",\"body\":{\"id\":\"00000000-0000-4000-a000-000000000002\",\"body\":{\"Sodium\":\"oqKioqKioqKioqKioqKioqKioqKioqKioqKioqKioqI=\"}}}"
+ },
+ {
+  "label": "no jobs yet",
+  "method": "GET",
+  "path": "/v1/aggregations/any/jobs",
+  "auth": [
+   "00000000-0000-4000-8000-000000000002",
+   "t0k3n-2"
+  ],
+  "request_body": None,
+  "status": 404,
+  "resource_not_found": "true",
+  "response_body": ""
+ },
+ {
+  "label": "create aggregation",
+  "method": "POST",
+  "path": "/v1/aggregations",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": "{\"id\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"title\":\"aggro\",\"vector_dimension\":10,\"modulus\":433,\"recipient\":\"00000000-0000-4000-8000-000000000001\",\"recipient_key\":\"00000000-0000-4000-a000-000000000001\",\"masking_scheme\":\"None\",\"committee_sharing_scheme\":{\"Additive\":{\"share_count\":3,\"modulus\":433}},\"recipient_encryption_scheme\":\"Sodium\",\"committee_encryption_scheme\":\"Sodium\"}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "list aggregations",
+  "method": "GET",
+  "path": "/v1/aggregations?recipient=00000000-0000-4000-8000-000000000001",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "[\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\"]"
+ },
+ {
+  "label": "suggestions",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/committee/suggestions",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "[{\"id\":\"00000000-0000-4000-8000-000000000001\",\"keys\":[\"00000000-0000-4000-a000-000000000001\"]},{\"id\":\"00000000-0000-4000-8000-000000000002\",\"keys\":[\"00000000-0000-4000-a000-000000000002\"]},{\"id\":\"00000000-0000-4000-8000-000000000003\",\"keys\":[\"00000000-0000-4000-a000-000000000003\"]},{\"id\":\"00000000-0000-4000-8000-000000000004\",\"keys\":[\"00000000-0000-4000-a000-000000000004\"]}]"
+ },
+ {
+  "label": "create committee",
+  "method": "POST",
+  "path": "/v1/aggregations/implied/committee",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": "{\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"clerks_and_keys\":[[\"00000000-0000-4000-8000-000000000001\",\"00000000-0000-4000-a000-000000000001\"],[\"00000000-0000-4000-8000-000000000002\",\"00000000-0000-4000-a000-000000000002\"],[\"00000000-0000-4000-8000-000000000003\",\"00000000-0000-4000-a000-000000000003\"]]}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "part-1 reads aggregation",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde",
+  "auth": [
+   "00000000-0000-4000-8000-000000000011",
+   "t0k3n-5"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"id\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"title\":\"aggro\",\"vector_dimension\":10,\"modulus\":433,\"recipient\":\"00000000-0000-4000-8000-000000000001\",\"recipient_key\":\"00000000-0000-4000-a000-000000000001\",\"masking_scheme\":\"None\",\"committee_sharing_scheme\":{\"Additive\":{\"share_count\":3,\"modulus\":433}},\"recipient_encryption_scheme\":\"Sodium\",\"committee_encryption_scheme\":\"Sodium\"}"
+ },
+ {
+  "label": "part-1 reads committee",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/committee",
+  "auth": [
+   "00000000-0000-4000-8000-000000000011",
+   "t0k3n-5"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"clerks_and_keys\":[[\"00000000-0000-4000-8000-000000000001\",\"00000000-0000-4000-a000-000000000001\"],[\"00000000-0000-4000-8000-000000000002\",\"00000000-0000-4000-a000-000000000002\"],[\"00000000-0000-4000-8000-000000000003\",\"00000000-0000-4000-a000-000000000003\"]]}"
+ },
+ {
+  "label": "part-1 participates",
+  "method": "POST",
+  "path": "/v1/aggregations/participations",
+  "auth": [
+   "00000000-0000-4000-8000-000000000011",
+   "t0k3n-5"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000031\",\"participant\":\"00000000-0000-4000-8000-000000000011\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"recipient_encryption\":null,\"clerk_encryptions\":[[\"00000000-0000-4000-8000-000000000001\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMTpjbGVyazA=\"}],[\"00000000-0000-4000-8000-000000000002\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMTpjbGVyazE=\"}],[\"00000000-0000-4000-8000-000000000003\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMTpjbGVyazI=\"}]]}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "part-2 participates",
+  "method": "POST",
+  "path": "/v1/aggregations/participations",
+  "auth": [
+   "00000000-0000-4000-8000-000000000012",
+   "t0k3n-6"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000032\",\"participant\":\"00000000-0000-4000-8000-000000000012\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"recipient_encryption\":null,\"clerk_encryptions\":[[\"00000000-0000-4000-8000-000000000001\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMjpjbGVyazA=\"}],[\"00000000-0000-4000-8000-000000000002\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMjpjbGVyazE=\"}],[\"00000000-0000-4000-8000-000000000003\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMjpjbGVyazI=\"}]]}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "part-3 participates",
+  "method": "POST",
+  "path": "/v1/aggregations/participations",
+  "auth": [
+   "00000000-0000-4000-8000-000000000013",
+   "t0k3n-7"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-8000-000000000033\",\"participant\":\"00000000-0000-4000-8000-000000000013\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"recipient_encryption\":null,\"clerk_encryptions\":[[\"00000000-0000-4000-8000-000000000001\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMzpjbGVyazA=\"}],[\"00000000-0000-4000-8000-000000000002\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMzpjbGVyazE=\"}],[\"00000000-0000-4000-8000-000000000003\",{\"Sodium\":\"c2VhbGVkOnBhcnQtMzpjbGVyazI=\"}]]}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "status pre-snapshot",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/status",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"number_of_participations\":3,\"snapshots\":[]}"
+ },
+ {
+  "label": "status as clerk-1 (ACL)",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/status",
+  "auth": [
+   "00000000-0000-4000-8000-000000000002",
+   "t0k3n-2"
+  ],
+  "request_body": None,
+  "status": 403,
+  "resource_not_found": None,
+  "response_body": "caller 00000000-0000-4000-8000-000000000002 is not 00000000-0000-4000-8000-000000000001"
+ },
+ {
+  "label": "wrong token",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/status",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "wrong-password"
+  ],
+  "request_body": None,
+  "status": 401,
+  "resource_not_found": None,
+  "response_body": "invalid token"
+ },
+ {
+  "label": "snapshot",
+  "method": "POST",
+  "path": "/v1/aggregations/implied/snapshot",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": "{\"id\":\"00000000-0000-4000-b000-000000000001\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\"}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "status post-snapshot",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/status",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"number_of_participations\":3,\"snapshots\":[{\"id\":\"00000000-0000-4000-b000-000000000001\",\"number_of_clerking_results\":0,\"result_ready\":false}]}"
+ },
+ {
+  "label": "recipient polls job",
+  "method": "GET",
+  "path": "/v1/aggregations/any/jobs",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"id\":\"070b6236-8787-5feb-8138-96d21392df64\",\"clerk\":\"00000000-0000-4000-8000-000000000001\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"snapshot\":\"00000000-0000-4000-b000-000000000001\",\"encryptions\":[{\"Sodium\":\"c2VhbGVkOnBhcnQtMTpjbGVyazA=\"},{\"Sodium\":\"c2VhbGVkOnBhcnQtMjpjbGVyazA=\"},{\"Sodium\":\"c2VhbGVkOnBhcnQtMzpjbGVyazA=\"}]}"
+ },
+ {
+  "label": "recipient posts result",
+  "method": "POST",
+  "path": "/v1/aggregations/implied/jobs/070b6236-8787-5feb-8138-96d21392df64/result",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": "{\"job\":\"070b6236-8787-5feb-8138-96d21392df64\",\"clerk\":\"00000000-0000-4000-8000-000000000001\",\"encryption\":{\"Sodium\":\"Y29tYmluZWQ6Y2xlcmsw\"}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "clerk-1 polls job",
+  "method": "GET",
+  "path": "/v1/aggregations/any/jobs",
+  "auth": [
+   "00000000-0000-4000-8000-000000000002",
+   "t0k3n-2"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"id\":\"7263f31d-803a-5676-ac03-ffa7fda4b981\",\"clerk\":\"00000000-0000-4000-8000-000000000002\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"snapshot\":\"00000000-0000-4000-b000-000000000001\",\"encryptions\":[{\"Sodium\":\"c2VhbGVkOnBhcnQtMTpjbGVyazE=\"},{\"Sodium\":\"c2VhbGVkOnBhcnQtMjpjbGVyazE=\"},{\"Sodium\":\"c2VhbGVkOnBhcnQtMzpjbGVyazE=\"}]}"
+ },
+ {
+  "label": "clerk-1 posts result",
+  "method": "POST",
+  "path": "/v1/aggregations/implied/jobs/7263f31d-803a-5676-ac03-ffa7fda4b981/result",
+  "auth": [
+   "00000000-0000-4000-8000-000000000002",
+   "t0k3n-2"
+  ],
+  "request_body": "{\"job\":\"7263f31d-803a-5676-ac03-ffa7fda4b981\",\"clerk\":\"00000000-0000-4000-8000-000000000002\",\"encryption\":{\"Sodium\":\"Y29tYmluZWQ6Y2xlcmsx\"}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "clerk-2 polls job",
+  "method": "GET",
+  "path": "/v1/aggregations/any/jobs",
+  "auth": [
+   "00000000-0000-4000-8000-000000000003",
+   "t0k3n-3"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"id\":\"61977034-4ec7-5379-85f7-dc680158d921\",\"clerk\":\"00000000-0000-4000-8000-000000000003\",\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"snapshot\":\"00000000-0000-4000-b000-000000000001\",\"encryptions\":[{\"Sodium\":\"c2VhbGVkOnBhcnQtMTpjbGVyazI=\"},{\"Sodium\":\"c2VhbGVkOnBhcnQtMjpjbGVyazI=\"},{\"Sodium\":\"c2VhbGVkOnBhcnQtMzpjbGVyazI=\"}]}"
+ },
+ {
+  "label": "clerk-2 posts result",
+  "method": "POST",
+  "path": "/v1/aggregations/implied/jobs/61977034-4ec7-5379-85f7-dc680158d921/result",
+  "auth": [
+   "00000000-0000-4000-8000-000000000003",
+   "t0k3n-3"
+  ],
+  "request_body": "{\"job\":\"61977034-4ec7-5379-85f7-dc680158d921\",\"clerk\":\"00000000-0000-4000-8000-000000000003\",\"encryption\":{\"Sodium\":\"Y29tYmluZWQ6Y2xlcmsy\"}}",
+  "status": 201,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "recipient drained",
+  "method": "GET",
+  "path": "/v1/aggregations/any/jobs",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 404,
+  "resource_not_found": "true",
+  "response_body": ""
+ },
+ {
+  "label": "status ready",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/status",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"aggregation\":\"ad3142d8-9a83-4f40-a64a-a8c90b701bde\",\"number_of_participations\":3,\"snapshots\":[{\"id\":\"00000000-0000-4000-b000-000000000001\",\"number_of_clerking_results\":3,\"result_ready\":true}]}"
+ },
+ {
+  "label": "snapshot result",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde/snapshots/00000000-0000-4000-b000-000000000001/result",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": "{\"snapshot\":\"00000000-0000-4000-b000-000000000001\",\"number_of_participations\":3,\"clerk_encryptions\":[{\"job\":\"070b6236-8787-5feb-8138-96d21392df64\",\"clerk\":\"00000000-0000-4000-8000-000000000001\",\"encryption\":{\"Sodium\":\"Y29tYmluZWQ6Y2xlcmsw\"}},{\"job\":\"61977034-4ec7-5379-85f7-dc680158d921\",\"clerk\":\"00000000-0000-4000-8000-000000000003\",\"encryption\":{\"Sodium\":\"Y29tYmluZWQ6Y2xlcmsy\"}},{\"job\":\"7263f31d-803a-5676-ac03-ffa7fda4b981\",\"clerk\":\"00000000-0000-4000-8000-000000000002\",\"encryption\":{\"Sodium\":\"Y29tYmluZWQ6Y2xlcmsx\"}}],\"recipient_encryptions\":null}"
+ },
+ {
+  "label": "delete aggregation",
+  "method": "DELETE",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 200,
+  "resource_not_found": None,
+  "response_body": ""
+ },
+ {
+  "label": "aggregation gone",
+  "method": "GET",
+  "path": "/v1/aggregations/ad3142d8-9a83-4f40-a64a-a8c90b701bde",
+  "auth": [
+   "00000000-0000-4000-8000-000000000001",
+   "t0k3n-1"
+  ],
+  "request_body": None,
+  "status": 404,
+  "resource_not_found": "true",
+  "response_body": ""
+ }
+]

@@ -1,0 +1,58 @@
+"""Replay-interop: the frozen walkthrough transcript against a live server.
+
+Replays tests/replay_transcript.py — the wire recording of the reference's
+``docs/simple-cli-example.sh`` scenario — over a real HTTP connection to
+``rest/server.py`` and asserts byte-identical response bodies, statuses,
+and the ``Resource-not-found`` header at every step. This pins the whole
+REST surface (routes, auth, status mapping, serde-compact JSON shapes) to
+the reference binding (server-http/src/lib.rs:20-60,298-343) far more
+strictly than per-resource fixtures: a field reorder, a whitespace change,
+a status drift, or an id-format change anywhere in the coordination plane
+fails the replay.
+
+Runs against the store matrix (mem / file / sqlite via SDA_TEST_STORE):
+candidate ordering is deterministic in all three because the fixed agent
+ids are assigned in ascending lexical order, so insertion order (mem),
+filename order (file), and ``ORDER BY signer`` (sqlite) coincide.
+"""
+
+import base64
+import http.client
+
+from replay_transcript import TRANSCRIPT
+from sda_fixtures import with_server
+
+
+def test_replay_walkthrough_transcript():
+    from sda_tpu.rest.server import serve_background
+
+    with with_server() as ctx:
+        with serve_background(ctx.server) as url:
+            host = url.split("//")[1]
+            conn = http.client.HTTPConnection(host, timeout=30)
+            for step in TRANSCRIPT:
+                headers = {}
+                if step["auth"] is not None:
+                    agent, password = step["auth"]
+                    headers["Authorization"] = "Basic " + base64.b64encode(
+                        f"{agent}:{password}".encode()
+                    ).decode()
+                body = None
+                if step["request_body"] is not None:
+                    body = step["request_body"].encode()
+                    headers["Content-Type"] = "application/json"
+                conn.request(step["method"], step["path"], body=body, headers=headers)
+                resp = conn.getresponse()
+                got_body = resp.read().decode()
+                label = step["label"]
+                assert resp.status == step["status"], (
+                    f"{label}: status {resp.status} != {step['status']}: {got_body}"
+                )
+                assert resp.headers.get("Resource-not-found") == step[
+                    "resource_not_found"
+                ], f"{label}: Resource-not-found header mismatch"
+                assert got_body == step["response_body"], (
+                    f"{label}: body diverged\n got: {got_body}\nwant: "
+                    f"{step['response_body']}"
+                )
+            conn.close()
