@@ -176,6 +176,20 @@ def mod_sum_wide_jnp(x, m, axis: int = 0):
     return x[0]
 
 
+def mod_sum_auto_jnp(x, m, axis: int = 0):
+    """Reduced sum-mod-m along ``axis``, exact for any ``|x| < m < 2**62``.
+
+    Single dispatch point for the narrow/wide bound: while
+    ``n*(m-1) < 2**63`` a plain int64 reduction + rem is exact (and
+    fastest); past it the halving mod-sum takes over. Every reduced
+    modular reduction in the engine routes through here so the bound
+    logic lives in exactly one place.
+    """
+    if x.shape[axis] * (m - 1) < 2**63:
+        return mod_sum_jnp(x, m, axis)
+    return mod_sum_wide_jnp(x, m, axis)
+
+
 def modmatmul_jnp(A, B, m):
     """Exact (A @ B) mod m on device; per-product reduction then int64 sum.
 

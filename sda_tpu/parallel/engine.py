@@ -139,7 +139,12 @@ def share_participants(
         # additive: n-1 uniform draws + closing share (additive.rs:42-48)
         P, d = secrets.shape
         draws = draw(key, (P, plan.share_count - 1, d), p)  # (P, n-1, d)
-        total = jnp.sum(draws.astype(jnp.int64), axis=1)
+        # a plain int64 sum of the n-1 draws overflows once
+        # (n-1)*(p-1) >= 2^63, silently corrupting the closing share;
+        # the auto dispatch switches to the halving mod-sum there
+        from ..ops.modular import mod_sum_auto_jnp
+
+        total = mod_sum_auto_jnp(draws, p, axis=1)
         last = lax.rem(secrets.astype(jnp.int64) - total, jnp.int64(p))
         return jnp.concatenate([draws.astype(jnp.int64), last[:, None, :]], axis=1)
 
@@ -209,9 +214,25 @@ def share_combine_limb(secrets, key, plan: AggregationPlan, draw=None):
 
 def clerk_combine(shares):
     """(P, n, B) -> (n, B) local modular sums — the clerk hot loop
-    (combiner.rs:16-30) as one reduction; caller supplies the modulus rem."""
+    (combiner.rs:16-30) as one reduction; caller supplies the modulus rem.
+
+    Exact only while P*(p-1) < 2^63 — use :func:`clerk_combine_mod` when
+    the modulus/participant count may exceed that bound."""
     jnp = _jnp()
     return jnp.sum(shares.astype(jnp.int64), axis=0)
+
+
+def clerk_combine_mod(shares, p: int):
+    """Reduced clerk sums over the participant axis, exact for any p < 2^62.
+
+    In the narrow regime (P*(p-1) < 2^63) this is bit-identical to
+    ``lax.rem(clerk_combine(shares), p)``; past the bound a plain int64 sum
+    silently wraps, so the halving mod-sum takes over — required for
+    additive sharing at 61-bit moduli (additive.rs:55-73 semantics)."""
+    _jnp()
+    from ..ops.modular import mod_sum_auto_jnp
+
+    return mod_sum_auto_jnp(shares, p, axis=0)
 
 
 def reconstruct(clerk_sums, indices, scheme, dim: int):
@@ -220,8 +241,12 @@ def reconstruct(clerk_sums, indices, scheme, dim: int):
     from jax import lax
 
     if isinstance(scheme, AdditiveSharing):
-        total = jnp.sum(clerk_sums.astype(jnp.int64), axis=0)
-        return lax.rem(total, jnp.int64(scheme.modulus))[:dim]
+        # wide moduli: n reduced rows still overflow a plain int64 sum
+        from ..ops.modular import mod_sum_auto_jnp
+
+        return mod_sum_auto_jnp(clerk_sums.astype(jnp.int64), scheme.modulus, axis=0)[
+            :dim
+        ]
     p = scheme.prime_modulus
     if p >= (1 << 31):
         # wide modulus: tiny matrices, exact host interpolation
@@ -258,12 +283,9 @@ class TpuAggregator:
 
     def secure_sum(self, secrets, key, indices=None):
         """(P, dim) -> (dim,) aggregate, all on device."""
-        jnp = _jnp()
-        from jax import lax
-
         p = self.plan.modulus
         shares = share_participants(secrets, key, self.plan, self.use_limbs)
-        sums = lax.rem(clerk_combine(shares), jnp.int64(p))
+        sums = clerk_combine_mod(shares, p)
         if indices is None:
             indices = range(self.plan.share_count)
         return reconstruct(sums, indices, self.scheme, self.dim)
@@ -289,7 +311,6 @@ class TpuAggregator:
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        jnp = _jnp()
         plan = self.plan
         use_limbs = self.use_limbs
         modulus = plan.modulus
@@ -307,8 +328,8 @@ class TpuAggregator:
             resharded = lax.all_to_all(
                 shares, "p", split_axis=1, concat_axis=0, tiled=True
             )
-            local = clerk_combine(resharded)  # (n/p, B) — all participants
-            return lax.rem(local, jnp.int64(modulus))
+            # all participants sum locally — wide-safe reduction
+            return clerk_combine_mod(resharded, modulus)  # (n/p, B)
 
         mapped = jax.shard_map(
             local_step,
@@ -390,14 +411,15 @@ class TpuAggregator:
         use_limbs = self.use_limbs
         modulus = plan.modulus
 
+        _check_psum_bound(self.mesh.shape["p"], modulus, "sharded_clerk_sums")
+
         def local_step(secrets, key):
             # per-device: share own participant slice, sum locally, psum.
             # every device folds all mesh coordinates into the key, so
             # every shard draws distinct randomness (see fold_mesh_axes)
             key = fold_mesh_axes(key, self.mesh)
             shares = share_participants(secrets, key, plan, use_limbs)
-            partial = clerk_combine(shares)  # (n, B_local) int64
-            partial = lax.rem(partial, jnp.int64(modulus))
+            partial = clerk_combine_mod(shares, modulus)  # (n, B_local)
             total = lax.psum(partial, axis_name="p")
             return lax.rem(total, jnp.int64(modulus))
 
@@ -410,6 +432,19 @@ class TpuAggregator:
         )
         return jax.jit(mapped)
 
+
+
+def _check_psum_bound(axis_size: int, modulus: int, where: str) -> None:
+    """psum adds ``axis_size`` reduced partials (each in (-m, m)) in int64 —
+    past ``axis_size*(m-1) < 2^63`` it silently wraps. Wide moduli must use
+    the limb-accumulator fabrics instead, which psum small exact int64
+    accumulators and recombine mod p once on host."""
+    if axis_size * (modulus - 1) >= 2**63:
+        raise ValueError(
+            f"{where}: psum of {axis_size} partials overflows int64 at "
+            f"modulus {modulus}; use sharded_limb_accumulators / "
+            "hierarchical_limb_accumulators for wide moduli"
+        )
 
 
 def validate_d_sharding(mesh, dim: int, input_size: int) -> None:
@@ -449,7 +484,6 @@ def verified_step(agg, sums_fn):
     sums plus an independent plaintext reduction of the same secrets.
     Shared by the single-mesh and multi-host (multihost.py) fabrics."""
     import jax
-    from jax import lax
 
     jnp = _jnp()
     scheme, dim = agg.scheme, agg.dim
@@ -457,8 +491,10 @@ def verified_step(agg, sums_fn):
     def step(secrets, key):
         sums = sums_fn(secrets, key)
         out = reconstruct(sums, range(agg.plan.share_count), scheme, dim)
-        plain = lax.rem(
-            jnp.sum(secrets.astype(jnp.int64), axis=0), jnp.int64(agg.plan.modulus)
+        from ..ops.modular import mod_sum_auto_jnp
+
+        plain = mod_sum_auto_jnp(
+            secrets.astype(jnp.int64), agg.plan.modulus, axis=0
         )
         return out, plain
 

@@ -132,11 +132,18 @@ def hierarchical_clerk_sums(scheme, dim: int, mesh):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    from .engine import TpuAggregator, clerk_combine, share_participants
+    from .engine import (
+        TpuAggregator,
+        _check_psum_bound,
+        clerk_combine_mod,
+        share_participants,
+    )
 
     agg = TpuAggregator(scheme, dim, mesh=mesh)
     plan = agg.plan
     agg.validate_d_sharding(dim)
+    _check_psum_bound(mesh.shape["p"], plan.modulus, "hierarchical_clerk_sums(p)")
+    _check_psum_bound(mesh.shape["h"], plan.modulus, "hierarchical_clerk_sums(h)")
     import jax.numpy as jnp
 
     from .engine import fold_mesh_axes
@@ -144,7 +151,7 @@ def hierarchical_clerk_sums(scheme, dim: int, mesh):
     def local_step(secrets, key):
         key = fold_mesh_axes(key, mesh)
         shares = share_participants(secrets, key, plan, False)
-        partial = lax.rem(clerk_combine(shares), jnp.int64(plan.modulus))
+        partial = clerk_combine_mod(shares, plan.modulus)
         partial = lax.rem(lax.psum(partial, axis_name="p"), jnp.int64(plan.modulus))
         # DCN stage: (n, B_local) int64 per host — KBs, independent of P
         total = lax.psum(partial, axis_name="h")

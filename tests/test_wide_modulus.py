@@ -101,6 +101,83 @@ def test_packed_shamir_61bit_host_path():
     np.testing.assert_array_equal(got, want)
 
 
+def test_device_additive_wide_share_columns():
+    """The closing-share sum at 61-bit moduli must not wrap int64.
+
+    Regression: ``share_participants``'s additive branch summed the n-1
+    draws with a plain int64 reduction, which overflows once
+    (n-1)*(p-1) >= 2^63 (n=8 corrupted ~11% of columns at p=2^61-1,
+    n=16 nearly all). Every column's exact python-int share sum must equal
+    the secret mod p — the same invariant the host generator keeps via
+    mod_sum_wide_np (crypto/sharing.py)."""
+    from sda_tpu.ops.jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel.engine import make_plan, share_participants
+
+    rng = np.random.default_rng(4)
+    for n in (8, 16):
+        scheme = AdditiveSharing(share_count=n, modulus=P61)
+        plan = make_plan(scheme, 64)
+        secrets = rng.integers(0, P61, size=(8, 64)).astype(np.int64)
+        shares = np.asarray(
+            share_participants(jnp.asarray(secrets), random.key(n), plan)
+        )  # (P, n, d)
+        assert shares.shape == (8, n, 64)
+        got = np.array(
+            [
+                [sum(int(s) for s in shares[i, :, j]) % P61 for j in range(64)]
+                for i in range(8)
+            ],
+            dtype=object,
+        )
+        want = secrets.astype(object) % P61
+        np.testing.assert_array_equal(got, want)
+
+
+def test_device_additive_wide_secure_sum():
+    """End-to-end device additive path at 61 bits: share -> clerk-combine ->
+    reconstruct, every reduction wide-safe (engine.py clerk_combine_mod +
+    the reconstruct additive branch)."""
+    from sda_tpu.ops.jaxcfg import ensure_x64
+
+    ensure_x64()
+    import jax.numpy as jnp
+    from jax import random
+
+    from sda_tpu.parallel import TpuAggregator
+
+    rng = np.random.default_rng(5)
+    dim = 32
+    for n in (8, 16):
+        scheme = AdditiveSharing(share_count=n, modulus=P61)
+        agg = TpuAggregator(scheme, dim)
+        secrets = rng.integers(P61 - 1000, P61, size=(16, dim)).astype(np.int64)
+        out = positive(np.asarray(agg.secure_sum(jnp.asarray(secrets), random.key(7))), P61)
+        want = np.array(
+            [sum(int(v) for v in secrets[:, j]) % P61 for j in range(dim)],
+            dtype=np.int64,
+        )
+        np.testing.assert_array_equal(out, want)
+
+
+def test_sharded_clerk_sums_raises_on_wide_psum():
+    """The narrow psum fabric must refuse wide moduli loudly (the psum of
+    reduced partials would wrap int64); the wide fabrics are the
+    limb-accumulator paths."""
+    import pytest
+
+    from sda_tpu.parallel import TpuAggregator, make_mesh
+
+    mesh = make_mesh(p_size=8)
+    agg = TpuAggregator(AdditiveSharing(share_count=4, modulus=P61), 16, mesh=mesh)
+    with pytest.raises(ValueError, match="limb"):
+        agg.sharded_clerk_sums()
+
+
 def test_sharded_wide_limb_accumulators():
     """BASELINE config 5 is 61-bit on an 8-chip mesh: the sharded wide
     path psums per-device limb accumulators over ICI (int64, exact) and
