@@ -247,7 +247,12 @@ def measure_rest_ingest() -> dict:
                          headers=headers)
             resp = conn.getresponse()
             resp.read()
-            assert resp.status in (200, 201, 404), (step["label"], resp.status)
+            # replayed setup steps must land on the transcript's recorded
+            # status; the hammered participation posts (fresh ids, not in
+            # the transcript) must be accepted — a 404-ing flow would
+            # otherwise yield a throughput number for a broken pipeline
+            want = (200, 201) if body is not None else (step["status"],)
+            assert resp.status in want, (step["label"], resp.status, want)
 
         # replay the transcript's setup prefix (agents, keys, aggregation,
         # committee) — same fixed identities, then hammer participations

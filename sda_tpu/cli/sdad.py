@@ -12,7 +12,6 @@ import argparse
 import logging
 import sys
 
-from ..rest import serve_forever
 from ..server import new_file_server, new_mem_server
 
 log = logging.getLogger("sda.sdad")
@@ -49,7 +48,16 @@ def main(argv=None) -> int:
         log.info("using in-memory store")
 
     host, _, port = args.bind.rpartition(":")
-    serve_forever((host or "127.0.0.1", int(port)), service)
+    from ..rest.server import listen
+
+    httpd = listen((host or "127.0.0.1", int(port)), service)
+    bound_host, bound_port = httpd.server_address[:2]
+    # report the bound address on stdout: with ``-b ip:0`` the kernel picks
+    # the port, so parent processes (tests, orchestration) parse this line
+    # instead of racing a probe-socket for a "free" port
+    print(f"sdad: listening on {bound_host}:{bound_port}", flush=True)
+    log.info("sda REST server listening on %s:%s", bound_host, bound_port)
+    httpd.serve_forever()
     return 0
 
 

@@ -219,8 +219,18 @@ def sharded_value_limb_sums(plan: AggregationPlan, mesh):
     from .engine import fold_mesh_axes, validate_d_sharding
 
     validate_d_sharding(mesh, plan.dim, plan.input_size)
+    p_size = mesh.shape["p"]
 
     def local_step(secrets, key):
+        # shapes are static under shard_map, so this enforces the documented
+        # *global* exactness bound at trace time (psum adds p_size shards),
+        # mirroring clerk_sums_sum_first's guard
+        if secrets.shape[0] * p_size > MAX_PARTICIPANTS:
+            raise ValueError(
+                f"global participant count {secrets.shape[0] * p_size} "
+                f"exceeds the exact limb-sum bound {MAX_PARTICIPANTS}; "
+                "chunk the input"
+            )
         key = fold_mesh_axes(key, mesh)
         acc = value_limb_sums_chunk(secrets, key, plan)
         return lax.psum(acc, axis_name="p")

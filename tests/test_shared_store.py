@@ -16,7 +16,9 @@ contention (WAL + busy_timeout + BEGIN IMMEDIATE, sqlstore.py).
 from __future__ import annotations
 
 import pathlib
-import socket
+import re
+import select
+import signal
 import subprocess
 import sys
 import threading
@@ -43,19 +45,67 @@ DIM = 8
 MODULUS = 433
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _spawn_sdad(db, extra_args=()) -> subprocess.Popen:
+    """Start an sdad process binding port 0; the kernel picks the port and
+    sdad reports it on stdout (no free-port probe, no TOCTOU race).
+    stderr goes to a sibling log file so a dead daemon is diagnosable."""
+    errlog = open(str(db) + f".sdad-{len(str(db))}-{time.monotonic_ns()}.stderr", "w")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "sda_tpu.cli.sdad",
+            "--sqlite",
+            str(db),
+            *extra_args,
+            "httpd",
+            "-b",
+            "127.0.0.1:0",
+        ],
+        cwd=REPO_ROOT,
+        stdout=subprocess.PIPE,
+        stderr=errlog,
+        text=True,
+    )
+    proc._sda_errlog_path = errlog.name  # for failure diagnostics
+    errlog.close()  # child holds the fd; parent only reads the path
+    return proc
+
+
+def _stderr_tail(proc, n: int = 20) -> str:
+    try:
+        lines = open(proc._sda_errlog_path).read().splitlines()
+        return "\n".join(lines[-n:])
+    except OSError:
+        return "<no stderr captured>"
+
+
+def _bound_port(proc, deadline_s: float = 30.0) -> int:
+    """Parse the ``sdad: listening on ip:port`` stdout line."""
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"sdad exited rc={proc.returncode}; stderr tail:\n"
+                + _stderr_tail(proc)
+            )
+        ready, _, _ = select.select([proc.stdout], [], [], 0.2)
+        if ready:
+            line = proc.stdout.readline()
+            m = re.search(r"listening on [\d.]+:(\d+)", line)
+            if m:
+                return int(m.group(1))
+    raise RuntimeError(f"sdad did not report a port within {deadline_s}s")
 
 
 def _wait_ready(port: int, proc, deadline_s: float = 30.0) -> None:
     end = time.monotonic() + deadline_s
     while time.monotonic() < end:
         if proc.poll() is not None:
-            raise RuntimeError(f"sdad exited rc={proc.returncode}")
+            raise RuntimeError(
+                f"sdad exited rc={proc.returncode}; stderr tail:\n"
+                + _stderr_tail(proc)
+            )
         try:
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/v1/ping", timeout=2
@@ -70,34 +120,20 @@ def _wait_ready(port: int, proc, deadline_s: float = 30.0) -> None:
 @pytest.fixture()
 def two_servers(tmp_path):
     """Two sdad subprocesses over one sqlite file; yields their base URLs."""
-    db = tmp_path / "shared.db"
-    ports = [_free_port(), _free_port()]
     procs = []
     try:
-        for port in ports:
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable,
-                        "-m",
-                        "sda_tpu.cli.sdad",
-                        "--sqlite",
-                        str(db),
-                        "httpd",
-                        "-b",
-                        f"127.0.0.1:{port}",
-                    ],
-                    cwd=REPO_ROOT,
-                    stdout=subprocess.DEVNULL,
-                    stderr=subprocess.DEVNULL,
-                )
-            )
-        for port, proc in zip(ports, procs):
+        urls = []
+        for _ in range(2):
+            proc = _spawn_sdad(tmp_path / "shared.db")
+            procs.append(proc)
+            port = _bound_port(proc)
             _wait_ready(port, proc)
-        yield [f"http://127.0.0.1:{p}" for p in ports]
+            urls.append(f"http://127.0.0.1:{port}")
+        yield urls
     finally:
         for proc in procs:
-            proc.terminate()
+            if proc.poll() is None:
+                proc.terminate()
         for proc in procs:
             try:
                 proc.wait(timeout=10)
@@ -172,6 +208,222 @@ def test_full_round_across_two_server_processes(tmp_path, two_servers):
     np.testing.assert_array_equal(
         output.positive().values, vectors.sum(axis=0) % MODULUS
     )
+
+
+def _integrity_ok(db) -> bool:
+    import sqlite3
+
+    conn = sqlite3.connect(str(db))
+    try:
+        return conn.execute("PRAGMA integrity_check").fetchone()[0] == "ok"
+    finally:
+        conn.close()
+
+
+def _rebind(client, service):
+    """Same identity/keystore, different server process (shared store)."""
+    from sda_tpu.client import SdaClient
+
+    return SdaClient(client.agent, client.crypto.keystore, service)
+
+
+def test_sigkill_server_process_mid_round(tmp_path):
+    """SIGKILL one of two sdad processes after jobs are enqueued: the
+    surviving process must carry the round to completion over the same
+    sqlite store, and the store must pass integrity_check. This is the
+    passive-resilience contract of the reference's multi-process mongo
+    deployment (server-store-mongodb/src/lib.rs:64-84) plus its
+    delete-after-result job durability (jfs_stores/clerking_jobs.rs:51-59),
+    under a hard process kill."""
+    db = tmp_path / "shared.db"
+    proc_a = _spawn_sdad(db)
+    proc_b = _spawn_sdad(db)
+    try:
+        port_a = _bound_port(proc_a)
+        _wait_ready(port_a, proc_a)
+        port_b = _bound_port(proc_b)
+        _wait_ready(port_b, proc_b)
+        url_a = f"http://127.0.0.1:{port_a}"
+        url_b = f"http://127.0.0.1:{port_b}"
+
+        recipient = new_client(
+            tmp_path / "recipient", _http_client(tmp_path / "ta", url_a)
+        )
+        rkey = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(rkey)
+        # clerks live on server B — the process that will be killed
+        clerks = [
+            new_client(tmp_path / f"clerk{i}", _http_client(tmp_path / f"tb{i}", url_b))
+            for i in range(3)
+        ]
+        for clerk in clerks:
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="crash-server",
+            vector_dimension=DIM,
+            modulus=MODULUS,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=ChaChaMasking(
+                modulus=MODULUS, dimension=DIM, seed_bitsize=128
+            ),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=MODULUS),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        rng = np.random.default_rng(23)
+        vectors = rng.integers(0, MODULUS, size=(4, DIM))
+        for i in range(4):
+            url = [url_a, url_b][i % 2]
+            part = new_client(
+                tmp_path / f"part{i}", _http_client(tmp_path / f"tp{i}", url)
+            )
+            part.upload_agent()
+            part.participate(vectors[i].tolist(), agg.id)
+        recipient.end_aggregation(agg.id)  # jobs now enqueued in the store
+
+        proc_b.send_signal(signal.SIGKILL)
+        proc_b.wait()
+
+        # every role fails over to the survivor — same identity AND same
+        # TOFU token (recorded in the shared store on first use), new URL
+        recipient.run_chores(-1)
+        for i, clerk in enumerate(clerks):
+            survivor = _http_client(tmp_path / f"tb{i}", url_a)
+            _rebind(clerk, survivor).run_chores(-1)
+        status = recipient.service.get_aggregation_status(recipient.agent, agg.id)
+        assert status.number_of_participations == 4
+        assert status.snapshots[0].result_ready
+        output = recipient.reveal_aggregation(agg.id)
+        np.testing.assert_array_equal(
+            output.positive().values, vectors.sum(axis=0) % MODULUS
+        )
+        assert _integrity_ok(db)
+    finally:
+        for proc in (proc_a, proc_b):
+            if proc.poll() is None:
+                proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+def test_sigkill_clerk_daemon_mid_job(tmp_path):
+    """SIGKILL a real ``sda clerk`` daemon subprocess while its job is in
+    flight: the job must stay queued (delete-after-result contract,
+    jfs_stores/clerking_jobs.rs:51-59 / server.rs:115-121), a restarted
+    clerk with the same identity re-polls it, and the round completes."""
+    import argparse
+
+    from sda_tpu.cli.sda import make_client
+    from sda_tpu.client import SdaClient
+
+    db = tmp_path / "crash.db"
+    proc = _spawn_sdad(db)
+    try:
+        port = _bound_port(proc)
+        _wait_ready(port, proc)
+        url = f"http://127.0.0.1:{port}"
+
+        recipient = new_client(
+            tmp_path / "recipient", _http_client(tmp_path / "tr", url)
+        )
+        rkey = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(rkey)
+
+        # clerk identities in the CLI's on-disk layout so real daemon
+        # subprocesses can load them
+        clerk_dirs = [tmp_path / f"cli-clerk{i}" for i in range(3)]
+        clerk_clients = []
+        for iddir in clerk_dirs:
+            ns = argparse.Namespace(identity=str(iddir), server=url)
+            service, identitystore, keystore, _ = make_client(ns)
+            agent = SdaClient.new_agent(keystore)
+            identitystore.put_aliased("agent", agent)
+            client = SdaClient(agent, keystore, service)
+            client.upload_agent()
+            client.upload_encryption_key(client.new_encryption_key())
+            clerk_clients.append(client)
+
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="crash-clerk",
+            vector_dimension=DIM,
+            modulus=MODULUS,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=ChaChaMasking(
+                modulus=MODULUS, dimension=DIM, seed_bitsize=128
+            ),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=MODULUS),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+        rng = np.random.default_rng(24)
+        vectors = rng.integers(0, MODULUS, size=(4, DIM))
+        for i in range(4):
+            part = new_client(
+                tmp_path / f"part{i}", _http_client(tmp_path / f"tp{i}", url)
+            )
+            part.upload_agent()
+            part.participate(vectors[i].tolist(), agg.id)
+        recipient.end_aggregation(agg.id)  # jobs enqueued
+
+        # a real clerk daemon starts chewing its queue — kill it hard
+        daemon = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "sda_tpu.cli.sda",
+                "-s",
+                url,
+                "-i",
+                str(clerk_dirs[0]),
+                "clerk",
+                "--poll-seconds",
+                "0.05",
+            ],
+            cwd=REPO_ROOT,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        time.sleep(0.5)  # somewhere between daemon boot and mid-job
+        daemon.send_signal(signal.SIGKILL)
+        daemon.wait()
+
+        # recipient may be a committee member too; run everyone, with the
+        # killed clerk restarted under the same identity (fresh client,
+        # same keystore): its job must still be pollable
+        recipient.run_chores(-1)
+        for client in clerk_clients:
+            client.run_chores(-1)
+
+        status = recipient.service.get_aggregation_status(recipient.agent, agg.id)
+        assert status.snapshots[0].result_ready
+        output = recipient.reveal_aggregation(agg.id)
+        np.testing.assert_array_equal(
+            output.positive().values, vectors.sum(axis=0) % MODULUS
+        )
+        assert _integrity_ok(db)
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
 
 
 def test_concurrent_participations_across_processes(tmp_path, two_servers):
