@@ -44,17 +44,36 @@ def varint_decode(buf: bytes) -> np.ndarray:
     return varint.decode_i64(buf)
 
 
-def seal_batch(messages: list, public_key: bytes) -> list:
+def _default_threads() -> int:
+    """Sealed-box worker threads: ``SDA_NATIVE_THREADS`` if set, else one
+    per CPU. The C plane strides the batch across a pthread pool with the
+    GIL released — results are independent of the thread count (each item
+    is sealed/opened by exactly one thread)."""
+    import os
+
+    env = os.environ.get("SDA_NATIVE_THREADS")
+    if env:
+        return max(1, int(env))
+    return os.cpu_count() or 1
+
+
+def seal_batch(messages: list, public_key: bytes, n_threads: int | None = None) -> list:
     if _ext is not None:
-        return _ext.seal_batch(list(messages), public_key)
+        return _ext.seal_batch(
+            list(messages), public_key, n_threads or _default_threads()
+        )
     from ..crypto import sodium
 
     return [sodium.seal(m, public_key) for m in messages]
 
 
-def open_batch(ciphertexts: list, public_key: bytes, secret_key: bytes) -> list:
+def open_batch(
+    ciphertexts: list, public_key: bytes, secret_key: bytes, n_threads: int | None = None
+) -> list:
     if _ext is not None:
-        return _ext.open_batch(list(ciphertexts), public_key, secret_key)
+        return _ext.open_batch(
+            list(ciphertexts), public_key, secret_key, n_threads or _default_threads()
+        )
     from ..crypto import sodium
 
     return [sodium.seal_open(c, public_key, secret_key) for c in ciphertexts]

@@ -15,6 +15,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <pthread.h>
 #include <stdint.h>
 #include <string.h>
 
@@ -116,82 +117,165 @@ static PyObject *varint_decode(PyObject *self, PyObject *args) {
     return res;
 }
 
-/* ---------------- sealed boxes ---------------- */
+/* ---------------- sealed boxes ----------------
+ *
+ * Both batch entry points take an optional trailing ``n_threads`` (default
+ * 1). The GIL is released for the whole batch either way; with n_threads
+ * > 1 the batch is strided across a pthread pool — each item's
+ * input/output buffer is touched by exactly one thread, and every Python
+ * object is created before the pool starts, so no Python API runs
+ * off-thread. libsodium seal/open are thread-safe (stateless; the
+ * ephemeral keypair inside crypto_box_seal draws from thread-safe
+ * randombytes). Failures record the lowest failing index so the raised
+ * error is deterministic regardless of thread interleaving. */
 
-/* seal_batch(messages: list[bytes], pk: bytes32) -> list[bytes] */
+typedef struct {
+    Py_ssize_t n, start, step;
+    const unsigned char **ins;
+    const Py_ssize_t *inlens;
+    unsigned char **outs;
+    const unsigned char *pk, *sk; /* sk NULL => seal, else open */
+    Py_ssize_t fail;              /* lowest failing index in stride, or -1 */
+} sealjob_t;
+
+static void *seal_open_worker(void *arg) {
+    sealjob_t *j = (sealjob_t *)arg;
+    for (Py_ssize_t i = j->start; i < j->n; i += j->step) {
+        int rc;
+        if (j->sk) {
+            rc = crypto_box_seal_open(j->outs[i], j->ins[i],
+                                      (unsigned long long)j->inlens[i], j->pk,
+                                      j->sk);
+        } else {
+            rc = crypto_box_seal(j->outs[i], j->ins[i],
+                                 (unsigned long long)j->inlens[i], j->pk);
+        }
+        if (rc != 0) {
+            j->fail = i;
+            return NULL; /* first failure in stride wins; lowest across
+                          * strides picked at join */
+        }
+    }
+    return NULL;
+}
+
+#define SEAL_MAX_THREADS 64
+
+/* shared body: sk==NULL for seal, non-NULL for open */
+static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
+                                 const unsigned char *sk, long n_threads) {
+    Py_ssize_t n = PyList_Size(items);
+    PyObject *out = PyList_New(n);
+    if (!out) return NULL;
+    const unsigned char **ins = PyMem_Malloc(sizeof(*ins) * (size_t)(n ? n : 1));
+    Py_ssize_t *inlens = PyMem_Malloc(sizeof(*inlens) * (size_t)(n ? n : 1));
+    unsigned char **outs = PyMem_Malloc(sizeof(*outs) * (size_t)(n ? n : 1));
+    if (!ins || !inlens || !outs) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    /* phase 1 (GIL held): pin input pointers, allocate every output. The
+     * list keeps each input bytes object alive for the whole call. */
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GetItem(items, i);
+        char *buf; Py_ssize_t blen;
+        if (PyBytes_AsStringAndSize(item, &buf, &blen) < 0) goto fail;
+        Py_ssize_t outlen;
+        if (sk) {
+            if (blen < (Py_ssize_t)crypto_box_SEALBYTES) {
+                PyErr_Format(PyExc_ValueError, "ciphertext %zd too short", i);
+                goto fail;
+            }
+            outlen = blen - crypto_box_SEALBYTES;
+        } else {
+            outlen = blen + crypto_box_SEALBYTES;
+        }
+        PyObject *res = PyBytes_FromStringAndSize(NULL, outlen);
+        if (!res) goto fail;
+        PyList_SET_ITEM(out, i, res);
+        ins[i] = (const unsigned char *)buf;
+        inlens[i] = blen;
+        outs[i] = (unsigned char *)PyBytes_AS_STRING(res);
+    }
+    /* phase 2 (GIL released): the crypto */
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > n) n_threads = n ? n : 1;
+    if (n_threads > SEAL_MAX_THREADS) n_threads = SEAL_MAX_THREADS;
+    {
+        Py_ssize_t first_fail = -1;
+        Py_BEGIN_ALLOW_THREADS
+        if (n_threads <= 1) {
+            sealjob_t job = {n, 0, 1, ins, inlens, outs, pk, sk, -1};
+            seal_open_worker(&job);
+            first_fail = job.fail;
+        } else {
+            sealjob_t jobs[SEAL_MAX_THREADS];
+            pthread_t tids[SEAL_MAX_THREADS];
+            int started[SEAL_MAX_THREADS];
+            for (long t = 0; t < n_threads; t++) {
+                sealjob_t j = {n, t, n_threads, ins, inlens, outs, pk, sk, -1};
+                jobs[t] = j;
+                started[t] =
+                    pthread_create(&tids[t], NULL, seal_open_worker, &jobs[t]) == 0;
+                if (!started[t]) seal_open_worker(&jobs[t]); /* inline fallback */
+            }
+            for (long t = 0; t < n_threads; t++) {
+                if (started[t]) pthread_join(tids[t], NULL);
+                if (jobs[t].fail >= 0 &&
+                    (first_fail < 0 || jobs[t].fail < first_fail))
+                    first_fail = jobs[t].fail;
+            }
+        }
+        Py_END_ALLOW_THREADS
+        if (first_fail >= 0) {
+            if (sk)
+                PyErr_Format(PyExc_ValueError, "sealed box %zd failed to open",
+                             first_fail);
+            else
+                PyErr_Format(PyExc_RuntimeError, "crypto_box_seal failed");
+            goto fail;
+        }
+    }
+    PyMem_Free(ins); PyMem_Free(inlens); PyMem_Free(outs);
+    return out;
+fail:
+    PyMem_Free(ins); PyMem_Free(inlens); PyMem_Free(outs);
+    Py_DECREF(out);
+    return NULL;
+}
+
+/* seal_batch(messages: list[bytes], pk: bytes32, n_threads=1) -> list[bytes] */
 static PyObject *seal_batch(PyObject *self, PyObject *args) {
     PyObject *msgs;
     Py_buffer pk;
-    if (!PyArg_ParseTuple(args, "O!y*", &PyList_Type, &msgs, &pk)) return NULL;
+    long n_threads = 1;
+    if (!PyArg_ParseTuple(args, "O!y*|l", &PyList_Type, &msgs, &pk, &n_threads))
+        return NULL;
     if (pk.len != crypto_box_PUBLICKEYBYTES) {
         PyBuffer_Release(&pk);
         return PyErr_Format(PyExc_ValueError, "public key must be 32 bytes");
     }
-    Py_ssize_t n = PyList_Size(msgs);
-    PyObject *out = PyList_New(n);
-    if (!out) { PyBuffer_Release(&pk); return NULL; }
-    for (Py_ssize_t i = 0; i < n; i++) {
-        PyObject *item = PyList_GetItem(msgs, i);
-        char *m; Py_ssize_t mlen;
-        if (PyBytes_AsStringAndSize(item, &m, &mlen) < 0) {
-            Py_DECREF(out); PyBuffer_Release(&pk); return NULL;
-        }
-        PyObject *ct = PyBytes_FromStringAndSize(NULL, mlen + crypto_box_SEALBYTES);
-        if (!ct) { Py_DECREF(out); PyBuffer_Release(&pk); return NULL; }
-        int rc;
-        Py_BEGIN_ALLOW_THREADS
-        rc = crypto_box_seal((unsigned char *)PyBytes_AS_STRING(ct),
-                             (const unsigned char *)m, (unsigned long long)mlen,
-                             (const unsigned char *)pk.buf);
-        Py_END_ALLOW_THREADS
-        if (rc != 0) {
-            Py_DECREF(ct); Py_DECREF(out); PyBuffer_Release(&pk);
-            return PyErr_Format(PyExc_RuntimeError, "crypto_box_seal failed");
-        }
-        PyList_SET_ITEM(out, i, ct);
-    }
+    PyObject *out = seal_open_batch(msgs, (const unsigned char *)pk.buf, NULL,
+                                    n_threads);
     PyBuffer_Release(&pk);
     return out;
 }
 
-/* open_batch(cts: list[bytes], pk: bytes32, sk: bytes32) -> list[bytes]
- * Raises ValueError naming the first forged index. */
+/* open_batch(cts: list[bytes], pk: bytes32, sk: bytes32, n_threads=1)
+ * -> list[bytes]; raises ValueError naming the lowest forged index. */
 static PyObject *open_batch(PyObject *self, PyObject *args) {
     PyObject *cts;
     Py_buffer pk, sk;
-    if (!PyArg_ParseTuple(args, "O!y*y*", &PyList_Type, &cts, &pk, &sk)) return NULL;
+    long n_threads = 1;
+    if (!PyArg_ParseTuple(args, "O!y*y*|l", &PyList_Type, &cts, &pk, &sk,
+                          &n_threads))
+        return NULL;
     if (pk.len != crypto_box_PUBLICKEYBYTES || sk.len != crypto_box_SECRETKEYBYTES) {
         PyBuffer_Release(&pk); PyBuffer_Release(&sk);
         return PyErr_Format(PyExc_ValueError, "keys must be 32 bytes");
     }
-    Py_ssize_t n = PyList_Size(cts);
-    PyObject *out = PyList_New(n);
-    if (!out) { PyBuffer_Release(&pk); PyBuffer_Release(&sk); return NULL; }
-    for (Py_ssize_t i = 0; i < n; i++) {
-        PyObject *item = PyList_GetItem(cts, i);
-        char *c; Py_ssize_t clen;
-        if (PyBytes_AsStringAndSize(item, &c, &clen) < 0) {
-            Py_DECREF(out); PyBuffer_Release(&pk); PyBuffer_Release(&sk); return NULL;
-        }
-        if (clen < (Py_ssize_t)crypto_box_SEALBYTES) {
-            Py_DECREF(out); PyBuffer_Release(&pk); PyBuffer_Release(&sk);
-            return PyErr_Format(PyExc_ValueError, "ciphertext %zd too short", i);
-        }
-        PyObject *pt = PyBytes_FromStringAndSize(NULL, clen - crypto_box_SEALBYTES);
-        if (!pt) { Py_DECREF(out); PyBuffer_Release(&pk); PyBuffer_Release(&sk); return NULL; }
-        int rc;
-        Py_BEGIN_ALLOW_THREADS
-        rc = crypto_box_seal_open((unsigned char *)PyBytes_AS_STRING(pt),
-                                  (const unsigned char *)c, (unsigned long long)clen,
-                                  (const unsigned char *)pk.buf,
-                                  (const unsigned char *)sk.buf);
-        Py_END_ALLOW_THREADS
-        if (rc != 0) {
-            Py_DECREF(pt); Py_DECREF(out); PyBuffer_Release(&pk); PyBuffer_Release(&sk);
-            return PyErr_Format(PyExc_ValueError, "sealed box %zd failed to open", i);
-        }
-        PyList_SET_ITEM(out, i, pt);
-    }
+    PyObject *out = seal_open_batch(cts, (const unsigned char *)pk.buf,
+                                    (const unsigned char *)sk.buf, n_threads);
     PyBuffer_Release(&pk);
     PyBuffer_Release(&sk);
     return out;
