@@ -25,6 +25,16 @@ print(jax.devices())" >&2; then
     exit 2
 fi
 
+echo "[revalidate] pallas kernel compile + parity smoke..." >&2
+# per-kernel compile/parity evidence (ops/chacha_pallas.py,
+# parallel/limb_pallas.py) — recorded even when a kernel fails, so a
+# round that catches a healthy chip always leaves an artifact either way.
+# No pipe: `python | tee` would report tee's status and swallow a failure.
+if ! python scripts/pallas_smoke.py > "$out/pallas-$stamp.json"; then
+    echo "[revalidate] pallas smoke FAILED (artifact saved); continuing" >&2
+fi
+cat "$out/pallas-$stamp.json"
+
 echo "[revalidate] smoke shape (--quick)..." >&2
 python bench.py --quick | tee "$out/quick-$stamp.json"
 

@@ -162,17 +162,19 @@ def _window_pairs(dim: int, modulus: int) -> int:
     return dim + int(expected - dim + margin) + 8
 
 
-def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "auto"):
-    """(P, w<=8) uint32 seeds -> (P, dim) int64 masks, all on device at once.
+def expand_seeds_counts(seed_words, dim: int, modulus: int, backend: str = "jnp"):
+    """Jit-safe core of :func:`expand_seeds_batch`: ``(P, w<=8)`` uint32
+    seeds -> ``((P, dim) int64 masks, (P,) int32 accepted-draw counts)``.
 
-    Batched twin of ``ops.chacha.expand_seed``: identical zone rejection and
-    per-seed draw order (stable compaction along the pair axis) over a
-    q-scaled overgenerated window (``_window_pairs``) — bit-equal to the
-    host path row by row. If a row still holds fewer than ``dim`` accepted
-    draws (~1e-9 per batch), raises ``SlackExhausted`` rather than return
-    wrong bits; eager-mode only for that reason (the guard reads a device
-    scalar). One flat kernel launch covers all P keystreams. ``backend``
-    as in ``_rounds``; ``ops.chacha.expand_seed_jnp`` is this with P=1.
+    Pure device computation, traceable under ``jax.jit`` / inside larger
+    fabrics: the slack guard is NOT applied here — a row whose window held
+    fewer than ``dim`` accepted draws has ``counts[p] < dim`` and undefined
+    trailing mask values; callers MUST check ``counts`` (host-side, in
+    their epilogue) before using the masks. :func:`expand_seeds_batch` is
+    the eager wrapper that does exactly that and raises ``SlackExhausted``.
+    ``backend`` must be resolved ("jnp"/"pallas"/"interpret") when called
+    under jit — "auto" probes the backend eagerly at trace time, which is
+    fine on first trace but pins the choice into the compiled computation.
     """
     from .jaxcfg import ensure_x64
 
@@ -183,7 +185,7 @@ def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "au
     seed_words = jnp.asarray(seed_words, dtype=jnp.uint32)
     P = seed_words.shape[0]
     if P == 0:
-        return jnp.zeros((0, dim), dtype=jnp.int64)
+        return jnp.zeros((0, dim), dtype=jnp.int64), jnp.zeros((0,), dtype=jnp.int32)
     zone = rand03_zone(modulus)  # rand-0.3 exact: rejection always applies
     need_pairs = _window_pairs(dim, modulus)
     n_blocks = (need_pairs * 2 + 15) // 16
@@ -194,22 +196,74 @@ def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "au
         jnp.uint64
     )
     ok = u64 < jnp.uint64(zone)
-    if int(jnp.sum(ok, axis=1).min()) < dim:
-        raise SlackExhausted(
-            f"seed window of {u64.shape[1]} pairs held < {dim} accepted draws"
-        )
+    counts = jnp.sum(ok, axis=1).astype(jnp.int32)
     # stable compaction by prefix sum + scatter (linear scan; an argsort
     # here lowers to a full sort network on TPU): accepted draw k lands
     # in slot (#accepted before k), rejected draws scatter out of bounds
     # and drop. Slots past the last accepted draw stay 0 but are never
-    # read — the guard above proves every row has >= dim accepted.
+    # read once the caller has validated ``counts``.
     window = u64.shape[1]
     pos = jnp.cumsum(ok.astype(jnp.int32), axis=1) - 1
     idx = jnp.where(ok, pos, window)  # out-of-bounds marker for rejected
     compact = jnp.zeros_like(u64).at[
         jnp.arange(P)[:, None], idx
     ].set(u64, mode="drop")
-    return (compact[:, :dim] % jnp.uint64(modulus)).astype(jnp.int64)
+    masks = (compact[:, :dim] % jnp.uint64(modulus)).astype(jnp.int64)
+    return masks, counts
+
+
+def expand_seeds_batch(seed_words, dim: int, modulus: int, *, backend: str = "auto"):
+    """(P, w<=8) uint32 seeds -> (P, dim) int64 masks, all on device at once.
+
+    Batched twin of ``ops.chacha.expand_seed``: identical zone rejection and
+    per-seed draw order (stable compaction along the pair axis) over a
+    q-scaled overgenerated window (``_window_pairs``) — bit-equal to the
+    host path row by row. If a row still holds fewer than ``dim`` accepted
+    draws (~1e-9 per batch), raises ``SlackExhausted`` rather than return
+    wrong bits. This wrapper reads the count scalar eagerly; fabrics that
+    need the expansion *inside* ``jax.jit`` use :func:`expand_seeds_counts`
+    and validate the returned counts in their epilogue. One flat kernel
+    launch covers all P keystreams. ``backend`` as in ``_rounds``;
+    ``ops.chacha.expand_seed_jnp`` is this with P=1.
+    """
+    masks, counts = expand_seeds_counts(seed_words, dim, modulus, backend)
+    import jax.numpy as jnp
+
+    if counts.shape[0] and int(jnp.min(counts)) < dim:
+        raise SlackExhausted(
+            f"seed window held < {dim} accepted draws in at least one row"
+        )
+    return masks
+
+
+def _fold_chunk(batch, dim: int, modulus: int, backend: str):
+    """One reveal fold: expand + reduce fused on device; only the tiny
+    (dim,) partial and (P,) accepted counts come back to host."""
+    import jax.numpy as jnp
+
+    from .modular import mod_sum_wide_jnp
+
+    masks, counts = expand_seeds_counts(batch, dim, modulus, backend)
+    if modulus <= (1 << 31):
+        part = jnp.sum(masks, axis=0) % jnp.int64(modulus)
+    else:
+        part = mod_sum_wide_jnp(masks, modulus, axis=0)
+    return part, counts
+
+
+#: module-level jit wrapper so the compile caches across reveal calls
+#: (keyed on chunk shape + the static (dim, modulus, backend) triple);
+#: built lazily because jax.jit at import time would initialize jax
+_FOLD_CHUNK_JIT = None
+
+
+def _fold_chunk_jit(batch, dim: int, modulus: int, backend: str):
+    global _FOLD_CHUNK_JIT
+    if _FOLD_CHUNK_JIT is None:
+        import jax
+
+        _FOLD_CHUNK_JIT = jax.jit(_fold_chunk, static_argnums=(1, 2, 3))
+    return _FOLD_CHUNK_JIT(batch, dim, modulus, backend)
 
 
 #: transient device-memory budget per fold of combine_masks_device; the
@@ -238,24 +292,30 @@ def combine_masks_device(seed_words, dim: int, modulus: int, *, chunk: int | Non
 
     if chunk is None:
         chunk = max(16, _COMBINE_BYTES_BUDGET // (5 * 8 * dim))
+    backend = "pallas" if pallas_available() else "jnp"
+
+    def fold_chunk(batch):
+        return _fold_chunk_jit(batch, dim, modulus, backend)
+
+    def host_fold(batch):
+        # ~1e-9-per-row event: host-expand just this chunk (the host path
+        # extends the stream on demand) and keep the device fold going
+        from .chacha import expand_seed
+
+        masks = jnp.asarray(np.stack([expand_seed(s, dim, modulus) for s in batch]))
+        if modulus <= (1 << 31):
+            return jnp.sum(masks, axis=0) % jnp.int64(modulus)
+        return mod_sum_wide_jnp(masks, modulus, axis=0)
+
     seed_words = np.asarray(seed_words, dtype=np.uint32)
     total = jnp.zeros((dim,), dtype=jnp.int64)
     for start in range(0, seed_words.shape[0], chunk):
         batch = seed_words[start : start + chunk]
-        try:
-            masks = expand_seeds_batch(jnp.asarray(batch), dim, modulus)
-        except SlackExhausted:
-            # ~1e-9-per-row event: host-expand just this chunk (the host
-            # path extends the stream on demand) and keep the device fold
-            from .chacha import expand_seed
-
+        part, counts = fold_chunk(jnp.asarray(batch))
+        if counts.shape[0] and int(jnp.min(counts)) < dim:
             logging.getLogger(__name__).info(
                 "rejection slack exhausted in chunk at %d; host-expanding it", start
             )
-            masks = jnp.asarray(np.stack([expand_seed(s, dim, modulus) for s in batch]))
-        if modulus <= (1 << 31):
-            part = jnp.sum(masks, axis=0) % jnp.int64(modulus)
-        else:
-            part = mod_sum_wide_jnp(masks, modulus, axis=0)
+            part = host_fold(batch)
         total = (total + part) % jnp.int64(modulus)
     return total
