@@ -1,0 +1,26 @@
+#!/bin/sh
+# The whole CI gate from a clean checkout — the analog of the reference's
+# Jenkinsfile:21-28 (build, test, `--features http` test, walkthrough
+# script), widened with the sqlite backend and the baseline-ladder smoke.
+#
+#   sh ci.sh            # suite + backend/binding matrix + ladder --quick
+#                       # + CLI acceptance (~15 min on one core)
+#
+# Stages:
+#   1. scripts/test-matrix.sh  — default suite, then the binding-sensitive
+#      tests against file/sqlite stores and the real REST stack
+#      (Jenkinsfile's `cargo test` + `cargo test --features http`),
+#      ending with scripts/baseline_ladder.py --quick (BASELINE.md config
+#      ladder at 1/100 participant scale, verification flags checked)
+#   2. scripts/simple-cli-example.sh — the reference walkthrough
+#      (docs/simple-cli-example.sh), expected `0 2 2 4 4 6 6 8 8 10`
+set -e
+cd "$(dirname "$0")"
+
+echo "=== ci 1/2: test suite + backend/binding matrix + ladder quick ==="
+sh scripts/test-matrix.sh
+
+echo "=== ci 2/2: CLI acceptance walkthrough ==="
+sh scripts/simple-cli-example.sh
+
+echo "=== ci: all gates passed ==="

@@ -9,3 +9,5 @@ SDA_TEST_STORE=file python -m pytest $BINDING_SENSITIVE -q
 SDA_TEST_STORE=sqlite python -m pytest $BINDING_SENSITIVE -q
 SDA_TEST_HTTP=1 python -m pytest $BINDING_SENSITIVE -q
 SDA_TEST_HTTP=1 SDA_TEST_STORE=sqlite python -m pytest tests/test_full_loop.py tests/test_models_federated.py -q
+# BASELINE.md config ladder at 1/100 scale — wall-clocks + verification flags
+python scripts/baseline_ladder.py --quick --out "${MATRIX_LADDER_OUT:-/tmp/ladder-matrix-quick.json}"
