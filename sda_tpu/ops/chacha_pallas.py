@@ -50,14 +50,16 @@ def _rounds_pallas(states, *, interpret: bool = False):
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    from .jaxcfg import I32_ZERO as zero  # literal 0 would trace as i64
+
     n = states.shape[0]
     padded = max(-(-n // _TILE), 1) * _TILE
     st = jnp.zeros((16, padded), dtype=jnp.uint32).at[:, :n].set(states.T)
     out = pl.pallas_call(
         _rounds_kernel,
         grid=(padded // _TILE,),
-        in_specs=[pl.BlockSpec((16, _TILE), lambda i: (0, i))],
-        out_specs=pl.BlockSpec((16, _TILE), lambda i: (0, i)),
+        in_specs=[pl.BlockSpec((16, _TILE), lambda i: (zero, i))],
+        out_specs=pl.BlockSpec((16, _TILE), lambda i: (zero, i)),
         out_shape=jax.ShapeDtypeStruct((16, padded), jnp.uint32),
         interpret=interpret,
     )(st)

@@ -6,6 +6,13 @@ so every module that touches jax calls ``ensure_x64()`` before tracing.
 
 from __future__ import annotations
 
+import numpy as np
+
+#: use in Pallas BlockSpec index maps instead of a literal ``0``: under
+#: jax_enable_x64 (the package default) a Python-int index traces as i64,
+#: which Mosaic's TPU compile rejects — witnessed on v5e 2026-07-31
+I32_ZERO = np.int32(0)
+
 _done = False
 
 

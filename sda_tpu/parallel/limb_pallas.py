@@ -73,7 +73,12 @@ def participant_limb_sums_pallas(values, stacks, block_c: int = 250):
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
             )  # (M, n)
-            red = jnp.sum(prod.reshape(block_c, nb, n), axis=0)  # (nb, n)
+            # dtype pinned: under x64, jnp.sum(int32) promotes its
+            # accumulator to int64, which Mosaic rejects; the int32 bound
+            # is already guaranteed by the C*LK*127^2 trace-time check
+            red = jnp.sum(
+                prod.reshape(block_c, nb, n), axis=0, dtype=jnp.int32
+            )  # (nb, n)
 
             @pl.when(j == 0)
             def _():
@@ -83,17 +88,18 @@ def participant_limb_sums_pallas(values, stacks, block_c: int = 250):
             def _():
                 out_ref[m] += red
 
+    from ..ops.jaxcfg import I32_ZERO as z  # literal 0 would trace as i64
     return pl.pallas_call(
         kernel,
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec(
-                (block_c, nb, K), lambda j: (j, 0, 0), memory_space=pltpu.VMEM
+                (block_c, nb, K), lambda j: (j, z, z), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec((L, LK, n), lambda j: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, LK, n), lambda j: (z, z, z), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (L, nb, n), lambda j: (0, 0, 0), memory_space=pltpu.VMEM
+            (L, nb, n), lambda j: (z, z, z), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((L, nb, n), jnp.int32),
         interpret=jax.default_backend() == "cpu",
