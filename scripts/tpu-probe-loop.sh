@@ -1,0 +1,42 @@
+#!/bin/sh
+# Periodic TPU health probe for builder sessions: the tunneled chip has
+# healthy windows between long wedges (see PROBE_r04.log), so waiting for
+# a single end-of-round bench misses them. This loop probes cheaply every
+# $INTERVAL seconds, appends one line per probe to $LOG, and the moment a
+# probe succeeds runs scripts/tpu-revalidate.sh (full bench + pallas smoke,
+# artifacts under bench-artifacts/) — at most once per $REVALIDATE_COOLDOWN
+# so a long healthy window doesn't burn the chip re-benching in a loop.
+#
+# Usage: sh scripts/tpu-probe-loop.sh [logfile]   (default PROBE_r04.log)
+# Runs until killed. Intended to run in the background for a whole session:
+#   nohup sh scripts/tpu-probe-loop.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-PROBE_r04.log}"
+INTERVAL="${INTERVAL:-600}"
+REVALIDATE_COOLDOWN="${REVALIDATE_COOLDOWN:-3600}"
+last_reval=0
+
+while :; do
+    # -k 15: a wedged chip ignores SIGTERM inside the native call.
+    # rc must come from timeout itself, not a trailing pipe stage (POSIX
+    # sh has no PIPESTATUS) — capture the output first, tail it after.
+    raw=$(timeout -k 15 90 python -c "
+import os, jax
+env = os.environ.get('JAX_PLATFORMS')
+env and jax.config.update('jax_platforms', env)
+print(jax.devices())" 2>&1)
+    rc=$?
+    out=$(printf '%s\n' "$raw" | tail -1)
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe rc=$rc $out" >> "$LOG"
+    if [ "$rc" -eq 0 ]; then
+        now=$(date +%s)
+        if [ $((now - last_reval)) -ge "$REVALIDATE_COOLDOWN" ]; then
+            echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) chip healthy; running tpu-revalidate.sh" >> "$LOG"
+            sh scripts/tpu-revalidate.sh >> "$LOG" 2>&1 || \
+                echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) revalidate FAILED rc=$?" >> "$LOG"
+            last_reval=$(date +%s)
+        fi
+    fi
+    sleep "$INTERVAL"
+done

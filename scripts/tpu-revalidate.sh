@@ -11,6 +11,11 @@ out="${1:-bench-artifacts}"
 mkdir -p "$out"
 stamp=$(date +%Y%m%d-%H%M%S)
 
+# the bench's crypto-plane riders measure the native extension when it is
+# importable; build it in place first so a fresh checkout reports real
+# native rates instead of the Python fallback (native_ext: false)
+python setup.py build_ext --inplace >/dev/null 2>&1 || true
+
 echo "[revalidate] probing device..." >&2
 # -k 15: a wedged chip leaves the child in an uninterruptible native
 # call that ignores SIGTERM — escalate to SIGKILL or this script hangs
