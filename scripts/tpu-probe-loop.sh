@@ -4,28 +4,33 @@
 # a single end-of-round bench misses them. This loop probes cheaply every
 # $INTERVAL seconds, appends one line per probe to $LOG, and the moment a
 # probe succeeds runs scripts/tpu-revalidate.sh (full bench + pallas smoke,
-# artifacts under bench-artifacts/) — at most once per $REVALIDATE_COOLDOWN
-# so a long healthy window doesn't burn the chip re-benching in a loop.
+# artifacts under bench-artifacts/). The revalidate cooldown is only
+# charged when revalidate actually completes — an immediate "device
+# unreachable" abort must not burn an hour against the next rare window.
 #
 # Usage: sh scripts/tpu-probe-loop.sh [logfile]   (default PROBE_r04.log)
 # Runs until killed. Intended to run in the background for a whole session:
 #   nohup sh scripts/tpu-probe-loop.sh &
+# Single-instance: a second copy probing mid-bench can perturb or wedge the
+# measurement, so startup is guarded by a lock directory.
 set -u
 cd "$(dirname "$0")/.."
 LOG="${1:-PROBE_r04.log}"
 INTERVAL="${INTERVAL:-600}"
 REVALIDATE_COOLDOWN="${REVALIDATE_COOLDOWN:-3600}"
-last_reval=0
+LOCKDIR="${TMPDIR:-/tmp}/sda-tpu-probe-loop.lock"
 
+if ! mkdir "$LOCKDIR" 2>/dev/null; then
+    echo "tpu-probe-loop: another instance holds $LOCKDIR; exiting" >&2
+    exit 1
+fi
+trap 'rmdir "$LOCKDIR" 2>/dev/null' EXIT INT TERM
+
+last_reval=0
 while :; do
-    # -k 15: a wedged chip ignores SIGTERM inside the native call.
-    # rc must come from timeout itself, not a trailing pipe stage (POSIX
-    # sh has no PIPESTATUS) — capture the output first, tail it after.
-    raw=$(timeout -k 15 90 python -c "
-import os, jax
-env = os.environ.get('JAX_PLATFORMS')
-env and jax.config.update('jax_platforms', env)
-print(jax.devices())" 2>&1)
+    # rc must come from the probe itself, not a trailing pipe stage
+    # (POSIX sh has no PIPESTATUS) — capture the output, tail it after
+    raw=$(sh scripts/tpu-probe.sh 90 2>&1)
     rc=$?
     out=$(printf '%s\n' "$raw" | tail -1)
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) probe rc=$rc $out" >> "$LOG"
@@ -33,9 +38,11 @@ print(jax.devices())" 2>&1)
         now=$(date +%s)
         if [ $((now - last_reval)) -ge "$REVALIDATE_COOLDOWN" ]; then
             echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) chip healthy; running tpu-revalidate.sh" >> "$LOG"
-            sh scripts/tpu-revalidate.sh >> "$LOG" 2>&1 || \
-                echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) revalidate FAILED rc=$?" >> "$LOG"
-            last_reval=$(date +%s)
+            if sh scripts/tpu-revalidate.sh >> "$LOG" 2>&1; then
+                last_reval=$(date +%s)   # full artifact set written
+            else
+                echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) revalidate did not complete (rc=$?); cooldown not charged" >> "$LOG"
+            fi
         fi
     fi
     sleep "$INTERVAL"

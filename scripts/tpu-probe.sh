@@ -1,0 +1,16 @@
+#!/bin/sh
+# Cheap TPU liveness probe — THE one probe both tpu-probe-loop.sh and
+# tpu-revalidate.sh call, so the load-bearing details stay in one place:
+#  - re-asserts JAX_PLATFORMS over the image's sitecustomize (which would
+#    otherwise initialize the possibly-wedged axon tunnel regardless)
+#  - timeout -k 15: a wedged chip leaves the child in an uninterruptible
+#    native call that ignores SIGTERM; escalate to SIGKILL or the caller
+#    hangs on exactly the failure it is trying to detect
+#
+# Usage: sh scripts/tpu-probe.sh [timeout_seconds]   (default 150)
+# Exit 0 with the device list on stdout iff the chip answered in time.
+timeout -k 15 "${1:-150}" python -c "
+import os, jax
+env = os.environ.get('JAX_PLATFORMS')
+env and jax.config.update('jax_platforms', env)
+print(jax.devices())"

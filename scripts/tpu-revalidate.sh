@@ -17,15 +17,9 @@ stamp=$(date +%Y%m%d-%H%M%S)
 python setup.py build_ext --inplace >/dev/null 2>&1 || true
 
 echo "[revalidate] probing device..." >&2
-# -k 15: a wedged chip leaves the child in an uninterruptible native
-# call that ignores SIGTERM — escalate to SIGKILL or this script hangs
-# on exactly the failure it exists to detect. The probe re-asserts
-# JAX_PLATFORMS over the image's sitecustomize like bench.py's probe.
-if ! timeout -k 15 150 python -c "
-import os, jax
-env = os.environ.get('JAX_PLATFORMS')
-env and jax.config.update('jax_platforms', env)
-print(jax.devices())" >&2; then
+# the shared probe (scripts/tpu-probe.sh) carries the two load-bearing
+# details: JAX_PLATFORMS re-assertion and SIGKILL escalation
+if ! sh scripts/tpu-probe.sh 150 >&2; then
     echo "[revalidate] device unreachable; aborting (nothing written)" >&2
     exit 2
 fi

@@ -1,0 +1,90 @@
+"""Source lint pinning the Mosaic i64 index-map regression.
+
+Under ``jax_enable_x64`` (the package default, ops/jaxcfg.py) a literal
+Python int returned from a ``BlockSpec`` index map traces as i64, which
+Mosaic's TPU compile rejects — the kernels then silently fall back to the
+jnp paths (chacha) or fail at trace time (limb). Witnessed on v5e
+2026-07-31; fixed by returning ``jaxcfg.I32_ZERO`` instead. The failure
+only reproduces on real TPU hardware (the CPU interpreter accepts i64),
+so the suite can't catch it functionally — this lint walks the AST of
+every in-package ``BlockSpec`` index-map lambda and rejects literal int
+elements in its return tuple.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+PKG = pathlib.Path(__file__).resolve().parents[1] / "sda_tpu"
+
+
+def _named_callables(tree):
+    """Module/function-scope names bound to a lambda or def — so an index
+    map factored out as ``_imap = lambda i: ...`` or ``def _imap(i): ...``
+    is still linted when passed to BlockSpec by name."""
+    named = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            named[node.name] = node
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Lambda):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    named[tgt.id] = node.value
+    return named
+
+
+def _blockspec_index_maps(tree):
+    """Yield (lineno, lambda_or_def_node) for every BlockSpec argument that
+    is a lambda, or a Name resolving to a module-level lambda/def."""
+    named = _named_callables(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = getattr(func, "attr", None) or getattr(func, "id", None)
+        if name != "BlockSpec":
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                yield node.lineno, arg
+            elif isinstance(arg, ast.Name) and arg.id in named:
+                yield node.lineno, named[arg.id]
+
+
+def _literal_int_returns(fn):
+    """Literal ints appearing anywhere in the returned expression(s) of an
+    index-map lambda or def (nested expressions included: ``(0, i)`` and
+    ``(i + 1, j)`` both flag)."""
+    if isinstance(fn, ast.Lambda):
+        returned = [fn.body]
+    else:  # ast.FunctionDef
+        returned = [
+            n.value
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Return) and n.value is not None
+        ]
+    return [
+        n.value
+        for expr in returned
+        for n in ast.walk(expr)
+        if isinstance(n, ast.Constant) and isinstance(n.value, int)
+    ]
+
+
+@pytest.mark.parametrize(
+    "path", sorted(PKG.rglob("*.py")), ids=lambda p: str(p.relative_to(PKG))
+)
+def test_no_literal_int_index_maps(path):
+    tree = ast.parse(path.read_text())
+    bad = [
+        (lineno, lits)
+        for lineno, fn in _blockspec_index_maps(tree)
+        for lits in [_literal_int_returns(fn)]
+        if lits
+    ]
+    assert not bad, (
+        f"{path}: BlockSpec index maps return literal ints {bad}; use "
+        "ops.jaxcfg.I32_ZERO — a Python int traces as i64 under x64 and "
+        "Mosaic rejects the kernel on TPU"
+    )
