@@ -522,6 +522,17 @@ def parse_args() -> argparse.Namespace:
         "acquisition and ride along in the metric line",
     )
     parser.add_argument(
+        "--rng",
+        choices=("threefry", "rbg"),
+        default="threefry",
+        help="PRNG for the synthetic stream: threefry (default; same "
+        "counter-based draws as the engine's simulation path) or rbg "
+        "(XLA RngBitGenerator — much cheaper per word on TPU). The "
+        "stream is synthetic and self-verified within the same jit, so "
+        "the choice affects only generation cost, never correctness; "
+        "the metric line records which one ran",
+    )
+    parser.add_argument(
         "--probe",
         type=float,
         default=None,
@@ -779,7 +790,11 @@ def run(args: argparse.Namespace, watchdog) -> int:
 
     acc = jnp.zeros(acc_shape, dtype=jnp.int64)
     plain = jnp.zeros((dim,), dtype=jnp.int64)
-    key = jax.random.key(42)
+    # rbg keys flow through the same split/fold_in/bits calls; only the
+    # per-word generation cost changes (threefry is ~a dozen VPU ops per
+    # 32-bit word, RngBitGenerator is near-free on TPU). impl=None keeps
+    # jax's default (threefry2x32) — "threefry" is not a registered name.
+    key = jax.random.key(42, impl=None if args.rng == "threefry" else args.rng)
 
     bench_t0 = time.perf_counter()
     with stage(f"compile + segment 1/{n_segments} ({seg_chunks} chunks)"):
@@ -866,6 +881,8 @@ def run(args: argparse.Namespace, watchdog) -> int:
         "dim": dim,
         "steady_s": round(steady_s, 3),
     }
+    if args.rng != "threefry":
+        result["rng"] = args.rng
     if partial:
         result["partial"] = True
     if includes_compile:

@@ -24,7 +24,11 @@ if ! mkdir "$LOCKDIR" 2>/dev/null; then
     echo "tpu-probe-loop: another instance holds $LOCKDIR; exiting" >&2
     exit 1
 fi
-trap 'rmdir "$LOCKDIR" 2>/dev/null' EXIT INT TERM
+# signals must *exit* (POSIX sh resumes the script after a trap that
+# doesn't), or `kill` would leave the loop running with no lock held
+trap 'rmdir "$LOCKDIR" 2>/dev/null' EXIT
+trap 'exit 130' INT
+trap 'exit 143' TERM
 
 last_reval=0
 while :; do

@@ -34,11 +34,21 @@ if ! python scripts/pallas_smoke.py > "$out/pallas-$stamp.json"; then
 fi
 cat "$out/pallas-$stamp.json"
 
+# no pipes around bench.py: `bench | tee` would report tee's status and a
+# mid-run crash (chip wedging after the probe passed) would masquerade as
+# success — the probe loop charges its revalidate cooldown off this
+# script's exit code. Write the artifact, then show it.
 echo "[revalidate] smoke shape (--quick)..." >&2
-python bench.py --quick | tee "$out/quick-$stamp.json"
+python bench.py --quick > "$out/quick-$stamp.json"
+cat "$out/quick-$stamp.json"
 
 echo "[revalidate] north-star shape (1M x 100K, 61-bit)..." >&2
-python bench.py | tee "$out/northstar-$stamp.json"
+python bench.py > "$out/northstar-$stamp.json"
+cat "$out/northstar-$stamp.json"
+
+echo "[revalidate] north-star with rbg generation (isolates threefry cost)..." >&2
+python bench.py --rng rbg --no-parity > "$out/northstar-rbg-$stamp.json"
+cat "$out/northstar-rbg-$stamp.json"
 
 echo "[revalidate] done; artifacts in $out/ — update README.md/docs/tpu.md" \
      "provenance notes with these numbers" >&2

@@ -53,9 +53,13 @@ def _blockspec_index_maps(tree):
 
 
 def _literal_int_returns(fn):
-    """Literal ints appearing anywhere in the returned expression(s) of an
-    index-map lambda or def (nested expressions included: ``(0, i)`` and
-    ``(i + 1, j)`` both flag)."""
+    """Literal ints appearing as *direct* elements of the returned tuple
+    (or as the whole returned expression) of an index-map lambda or def.
+
+    Only bare literals become standalone i64 constants under x64; a
+    literal inside arithmetic with the i32 program-id tracer (``i * 2``)
+    stays i32 via weak-type promotion and is legitimate — nested
+    constants are deliberately not flagged."""
     if isinstance(fn, ast.Lambda):
         returned = [fn.body]
     else:  # ast.FunctionDef
@@ -64,11 +68,16 @@ def _literal_int_returns(fn):
             for n in ast.walk(fn)
             if isinstance(n, ast.Return) and n.value is not None
         ]
+    elts = [
+        e
+        for body in returned
+        for e in (body.elts if isinstance(body, ast.Tuple) else [body])
+    ]
     return [
-        n.value
-        for expr in returned
-        for n in ast.walk(expr)
-        if isinstance(n, ast.Constant) and isinstance(n.value, int)
+        e.value
+        for e in elts
+        if isinstance(e, ast.Constant)
+        and type(e.value) is int  # bool subclasses int; not an index
     ]
 
 
