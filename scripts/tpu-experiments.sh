@@ -1,0 +1,33 @@
+#!/bin/sh
+# Perf experiment sweep for a healthy-chip window: north-star shape at
+# chunk x rng variants, each capped with --budget so the whole sweep fits
+# in a short window (partial results are still verified and rate-bearing).
+# Run AFTER scripts/tpu-revalidate.sh has banked the canonical artifacts.
+#
+# Usage: sh scripts/tpu-experiments.sh [outdir] [budget_seconds_per_run]
+set -e
+cd "$(dirname "$0")/.."
+out="${1:-bench-artifacts}"
+budget="${2:-45}"
+mkdir -p "$out"
+stamp=$(date +%Y%m%d-%H%M%S)
+
+if ! sh scripts/tpu-probe.sh 120 >&2; then
+    echo "[experiments] device unreachable; aborting" >&2
+    exit 2
+fi
+
+for rng in threefry rbg; do
+    for chunk in 500 2000 8000; do
+        tag="$rng-c$chunk"
+        echo "[experiments] north-star $tag (budget ${budget}s)..." >&2
+        # no pipe: a mid-run crash must fail the sweep visibly
+        if python bench.py --rng "$rng" --chunk "$chunk" --no-parity \
+            --budget "$budget" > "$out/exp-$tag-$stamp.json"; then
+            cat "$out/exp-$tag-$stamp.json"
+        else
+            echo "[experiments] $tag FAILED (artifact may be partial)" >&2
+        fi
+    done
+done
+echo "[experiments] sweep done; artifacts in $out/exp-*-$stamp.json" >&2
