@@ -34,6 +34,7 @@ import argparse
 import contextlib
 import json
 import os
+import pathlib
 import subprocess
 import sys
 import threading
@@ -57,6 +58,41 @@ _CRYPTO_STATS: dict = {}
 _PARITY_STATS: dict = {}
 
 
+def _last_witnessed() -> dict | None:
+    """Most recent committed non-zero north-star metric line from
+    bench-artifacts/ (written by scripts/tpu-revalidate.sh during healthy
+    chip windows), with its artifact name for provenance.
+
+    The tunneled chip wedges for hours at a time; a bench run that lands
+    in a wedge should still surface the most recent *witnessed* number —
+    clearly labeled as such, never as this run's value."""
+    here = pathlib.Path(__file__).resolve().parent / "bench-artifacts"
+    best: dict | None = None
+    # main-config artifacts only (northstar-<stamp>.json): the rbg variant
+    # (northstar-rbg-*) measures a different generator config. Newest by
+    # mtime, not name — lexicographic order would rank 'rbg' over digits.
+    candidates = [
+        f
+        for f in here.glob("northstar-*.json")
+        if f.name.split("-", 1)[1][0].isdigit()
+    ]
+    for f in sorted(candidates, key=lambda f: f.stat().st_mtime, reverse=True):
+        try:
+            data = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict) and data.get("value"):
+            best = {
+                "value": data["value"],
+                "unit": data.get("unit"),
+                "vs_baseline": data.get("vs_baseline"),
+                "steady_s": data.get("steady_s"),
+                "artifact": f.name,
+            }
+            break
+    return best
+
+
 def emit_error(msg: str) -> None:
     """The contract: whatever goes wrong, stdout carries exactly one
     well-formed error-tagged metric line (never a raw traceback, never
@@ -68,6 +104,9 @@ def emit_error(msg: str) -> None:
         "vs_baseline": 0.0,
         "error": msg,
     }
+    witnessed = _last_witnessed()
+    if witnessed:
+        line["last_witnessed"] = witnessed
     if _CRYPTO_STATS:
         line["crypto"] = _CRYPTO_STATS
     if _PARITY_STATS:
