@@ -12,22 +12,43 @@ budget="${2:-45}"
 mkdir -p "$out"
 stamp=$(date +%Y%m%d-%H%M%S)
 
+# same wedge protection as tpu-revalidate.sh: a chip that dies mid-sweep
+# must not hold the probe loop's window hostage for bench.py's 50-minute
+# default deadline per run
+SDA_BENCH_DEADLINE="${SDA_BENCH_DEADLINE:-900}"
+export SDA_BENCH_DEADLINE
+
 if ! sh scripts/tpu-probe.sh 120 >&2; then
     echo "[experiments] device unreachable; aborting" >&2
     exit 2
 fi
 
+# run_one TAG [bench flags...]: one budget-capped north-star variant,
+# artifact exp-TAG-$stamp.json. No pipe around bench.py: a mid-run crash
+# must fail the run visibly, not hide behind tee's exit status.
+run_one() {
+    tag="$1"; shift
+    echo "[experiments] north-star $tag (budget ${budget}s)..." >&2
+    if python bench.py --no-parity --budget "$budget" "$@" \
+        > "$out/exp-$tag-$stamp.json"; then
+        cat "$out/exp-$tag-$stamp.json"
+    else
+        echo "[experiments] $tag FAILED (artifact may be partial)" >&2
+    fi
+}
+
 for rng in threefry rbg; do
     for chunk in 500 2000 8000; do
-        tag="$rng-c$chunk"
-        echo "[experiments] north-star $tag (budget ${budget}s)..." >&2
-        # no pipe: a mid-run crash must fail the sweep visibly
-        if python bench.py --rng "$rng" --chunk "$chunk" --no-parity \
-            --budget "$budget" > "$out/exp-$tag-$stamp.json"; then
-            cat "$out/exp-$tag-$stamp.json"
-        else
-            echo "[experiments] $tag FAILED (artifact may be partial)" >&2
-        fi
+        run_one "$rng-c$chunk" --rng "$rng" --chunk "$chunk"
+    done
+done
+
+# how much of the timed loop is the independent plain-sum check (bench
+# scaffolding, not fabric work — see bench.py --check help)? probe keeps
+# a byte-exact comparison on ~1024 strided columns; off removes it
+for rng in threefry rbg; do
+    for check in probe off; do
+        run_one "$rng-$check" --rng "$rng" --check "$check"
     done
 done
 echo "[experiments] sweep done; artifacts in $out/exp-*-$stamp.json" >&2

@@ -55,8 +55,21 @@ def test_bench_cpu_smoke_all_engines():
 
     repo, env = _cpu_bench_env()
     # --quick pins the narrow 31-bit sumfirst branch (the bare default
-    # would force --wide and duplicate that case)
-    for extra in (["--quick"], ["--wide"], ["--engine", "participant"]):
+    # would force --wide and duplicate that case); the --check variants
+    # cover the reduced/skipped independent-verification modes on both
+    # the narrow and the wide (uint32-pair) sumfirst paths. The probe
+    # variants override --dim to 2100 (argparse: last flag wins) so
+    # check_stride is 2 and dim % stride != 0 — the strided-subset
+    # slicing and its finalize alignment really execute; at dim 60 the
+    # stride would be 1 and probe would be byte-identical to full
+    for extra in (
+        ["--quick"],
+        ["--wide"],
+        ["--engine", "participant"],
+        ["--quick", "--check", "probe", "--dim", "2100"],
+        ["--wide", "--check", "probe", "--dim", "2100"],
+        ["--wide", "--check", "off"],
+    ):
         out = subprocess.run(
             [
                 sys.executable,
@@ -80,6 +93,13 @@ def test_bench_cpu_smoke_all_engines():
         parity = line["tpu_parity"]
         assert parity["ok"] is True, parity
         assert parity["chacha"] == parity["limb"] == parity["wide61"] == "ok"
+        if "--check" in extra:
+            mode = extra[extra.index("--check") + 1]
+            assert line["check"] == mode
+            if mode == "probe":
+                # dim 2100 -> stride 2 -> ceil(2100/2) covered columns;
+                # strictly fewer than dim proves the subset path ran
+                assert line["check_cols"] == 1050 < line["dim"]
 
 
 def test_bench_deadline_emits_error_metric():
