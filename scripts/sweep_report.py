@@ -1,0 +1,91 @@
+"""Summarize healthy-window experiment artifacts into a defaults table.
+
+``scripts/tpu-experiments.sh`` banks budget-capped north-star variants as
+``bench-artifacts/exp-<tag>-<stamp>.json``. This reads them all, groups by
+configuration (rng x chunk x check), and prints per-config best rates plus
+a recommendation line — the evidence trail for changing bench defaults
+(e.g. ``--chunk``) between rounds. Partial runs are rate-bearing (the
+bench verifies what it measured before stopping), so they count, flagged.
+
+Usage: python scripts/sweep_report.py [artifact_dir]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+
+def load(artdir: pathlib.Path):
+    rows = []
+    for f in sorted(artdir.glob("exp-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict) or not d.get("value"):
+            continue  # error lines / empty artifacts carry no rate
+        rows.append(
+            {
+                "artifact": f.name,
+                "rng": d.get("rng", "threefry"),
+                "check": d.get("check", "full"),
+                "value": d["value"],
+                "steady_s": d.get("steady_s"),
+                "partial": bool(d.get("partial")),
+                "dim": d.get("dim"),
+                "participants": d.get("participants"),
+            }
+        )
+    return rows
+
+
+def tag_of(row):
+    # chunk is not in the metric line; recover it from the artifact tag
+    # (exp-<rng>-c<chunk>-<stamp>.json / exp-<rng>-<check>-<stamp>.json)
+    parts = row["artifact"].split("-")
+    chunk = next((p[1:] for p in parts if p.startswith("c") and p[1:].isdigit()), None)
+    return row["rng"], chunk, row["check"]
+
+
+def main() -> int:
+    artdir = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench-artifacts")
+    rows = load(artdir)
+    if not rows:
+        print(f"no rate-bearing exp-*.json artifacts under {artdir}/", file=sys.stderr)
+        return 1
+
+    best: dict[tuple, dict] = {}
+    for r in rows:
+        key = tag_of(r)
+        if key not in best or r["value"] > best[key]["value"]:
+            best[key] = r
+
+    print(f"{'rng':>9} {'chunk':>6} {'check':>6} {'elems/s':>12} "
+          f"{'steady_s':>9} {'partial':>7}  artifact")
+    for key in sorted(best):
+        r = best[key]
+        rng, chunk, check = key
+        print(
+            f"{rng:>9} {chunk or '-':>6} {check:>6} {r['value']:>12.3e} "
+            f"{r['steady_s'] if r['steady_s'] is not None else float('nan'):>9} "
+            f"{'yes' if r['partial'] else 'no':>7}  {r['artifact']}"
+        )
+
+    # recommendation: fastest full-check config is eligible to become the
+    # bench default (the headline keeps the strongest verification); the
+    # fastest overall quantifies the scaffolding/rng headroom
+    full = [r for k, r in best.items() if k[2] == "full"]
+    if full:
+        top = max(full, key=lambda r: r["value"])
+        print(f"\nfastest full-check config: {tag_of(top)} at {top['value']:.3e} el/s "
+              f"({top['artifact']})")
+    top_any = max(best.values(), key=lambda r: r["value"])
+    print(f"fastest overall:           {tag_of(top_any)} at {top_any['value']:.3e} el/s "
+          f"({top_any['artifact']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
