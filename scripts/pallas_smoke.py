@@ -107,6 +107,53 @@ def main() -> int:
     item("chacha_expand_61bit", chacha_expand)
     item("limb_participant_fused", limb)
 
+    # informative (never gates `ok`): steady-state expansion throughput of
+    # the two on-device ChaCha backends at a fabric-sized shape, so the
+    # masking fabric's "auto -> pallas" preference rests on a measured
+    # ratio, not on the kernel merely existing. Fence via a tiny slice of
+    # the output (its D2H transfer awaits execution; a plain
+    # block_until_ready has misreported on the relay backend before).
+    def expand_rates():
+        import jax.numpy as jnp
+
+        from sda_tpu.ops.chacha_pallas import expand_seeds_counts
+
+        P, dim, m = 256, 65536, (1 << 61) - 1
+        rng = np.random.default_rng(10)
+        base = rng.integers(0, 1 << 32, size=(P, 4), dtype=np.uint64).astype(
+            np.uint32
+        )
+        fn = jax.jit(expand_seeds_counts, static_argnums=(1, 2, 3))
+        rates = {}
+        for backend in ("jnp", "pallas"):
+            try:
+                seeds = jnp.asarray(base)
+                masks, _ = fn(seeds, dim, m, backend)  # compile + warm
+                np.asarray(masks[:1, :8])
+                t0 = time.perf_counter()
+                passes = 3
+                for i in range(1, passes + 1):
+                    masks, _ = fn(seeds + jnp.uint32(i), dim, m, backend)
+                    np.asarray(masks[:1, :8])
+                dt = time.perf_counter() - t0
+                rates[f"{backend}_elems_per_s"] = round(passes * P * dim / dt, 1)
+            except Exception as exc:  # one backend failing must not
+                rates[f"{backend}_error"] = (  # erase the other's rate
+                    f"{type(exc).__name__}: {exc}"
+                )
+        if "jnp_elems_per_s" in rates and "pallas_elems_per_s" in rates:
+            rates["pallas_over_jnp"] = round(
+                rates["pallas_elems_per_s"] / rates["jnp_elems_per_s"], 3
+            )
+        return rates
+
+    try:
+        out["chacha_expand_throughput"] = expand_rates()
+    except Exception as exc:  # informative only — never break the smoke
+        out["chacha_expand_throughput"] = {
+            "error": f"{type(exc).__name__}: {exc}"
+        }
+
     ok = all(r.get("compiled") and r.get("parity") for r in results.values())
     out["ok"] = ok
     print(json.dumps(out))
