@@ -165,8 +165,17 @@ static void *seal_open_worker(void *arg) {
 static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
                                  const unsigned char *sk, long n_threads) {
     Py_ssize_t n = PyList_Size(items);
+    /* pin the inputs with strong refs: phase 2 runs with the GIL
+     * released, and a caller thread mutating its list there would
+     * otherwise drop the last ref to a bytes object whose buffer a
+     * worker is still reading */
+    items = PyList_GetSlice(items, 0, n);
+    if (!items) return NULL;
     PyObject *out = PyList_New(n);
-    if (!out) return NULL;
+    if (!out) {
+        Py_DECREF(items);
+        return NULL;
+    }
     const unsigned char **ins = PyMem_Malloc(sizeof(*ins) * (size_t)(n ? n : 1));
     Py_ssize_t *inlens = PyMem_Malloc(sizeof(*inlens) * (size_t)(n ? n : 1));
     unsigned char **outs = PyMem_Malloc(sizeof(*outs) * (size_t)(n ? n : 1));
@@ -237,9 +246,11 @@ static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
         }
     }
     PyMem_Free(ins); PyMem_Free(inlens); PyMem_Free(outs);
+    Py_DECREF(items);
     return out;
 fail:
     PyMem_Free(ins); PyMem_Free(inlens); PyMem_Free(outs);
+    Py_DECREF(items);
     Py_DECREF(out);
     return NULL;
 }
