@@ -14,19 +14,25 @@
 #      ladder at 1/100 participant scale, verification flags checked)
 #   2. scripts/simple-cli-example.sh — the reference walkthrough
 #      (docs/simple-cli-example.sh), expected `0 2 2 4 4 6 6 8 8 10`
+#   3. examples/ — both runnable end-to-end demos (federated training,
+#      federated analytics) must keep running as documented
 set -e
 cd "$(dirname "$0")"
 
-echo "=== ci 0/2: build native extension (Jenkinsfile 'build' stage) ==="
+echo "=== ci 0/3: build native extension (Jenkinsfile 'build' stage) ==="
 # in-place so the suite, bench.py, and the CLI all pick it up from the
 # checkout; the crypto plane falls back to Python if this fails, so a
 # missing toolchain degrades rates, not correctness
 python setup.py build_ext --inplace || echo "ci: native build failed; Python fallback paths will carry the crypto plane" >&2
 
-echo "=== ci 1/2: test suite + backend/binding matrix + ladder quick ==="
+echo "=== ci 1/3: test suite + backend/binding matrix + ladder quick ==="
 sh scripts/test-matrix.sh
 
-echo "=== ci 2/2: CLI acceptance walkthrough ==="
+echo "=== ci 2/3: CLI acceptance walkthrough ==="
 sh scripts/simple-cli-example.sh
+
+echo "=== ci 3/3: runnable examples (user-facing docs must not rot) ==="
+python examples/federated_training.py >/dev/null
+python examples/federated_analytics.py >/dev/null
 
 echo "=== ci: all gates passed ==="
