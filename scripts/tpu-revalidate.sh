@@ -32,27 +32,32 @@ if ! sh scripts/tpu-probe.sh 150 >&2; then
     exit 2
 fi
 
-echo "[revalidate] pallas kernel compile + parity smoke..." >&2
+# Banking order is value order — observed windows can close in ~4 min
+# (PROBE_r04.log 03:18 UTC), so the headline artifact goes FIRST:
+#   1. north-star with full parity riders (THE number + on-device parity)
+#   2. quick smoke, parity skipped (the north-star's rider just covered it)
+#   3. pallas compile/parity/throughput smoke
+#   4. rbg north-star (isolates threefry generation cost)
+# No pipes around bench.py: `bench | tee` would report tee's status and a
+# mid-run crash (chip wedging after the probe passed) would masquerade as
+# success — the probe loop charges its revalidate cooldown off this
+# script's exit code. Write the artifact, then show it.
+echo "[revalidate] north-star shape (1M x 100K, 61-bit)..." >&2
+python bench.py > "$out/northstar-$stamp.json"
+cat "$out/northstar-$stamp.json"
+
+echo "[revalidate] smoke shape (--quick, parity covered above)..." >&2
+python bench.py --quick --no-parity > "$out/quick-$stamp.json"
+cat "$out/quick-$stamp.json"
+
+echo "[revalidate] pallas kernel compile + parity + throughput smoke..." >&2
 # per-kernel compile/parity evidence (ops/chacha_pallas.py,
 # parallel/limb_pallas.py) — recorded even when a kernel fails, so a
 # round that catches a healthy chip always leaves an artifact either way.
-# No pipe: `python | tee` would report tee's status and swallow a failure.
 if ! python scripts/pallas_smoke.py > "$out/pallas-$stamp.json"; then
     echo "[revalidate] pallas smoke FAILED (artifact saved); continuing" >&2
 fi
 cat "$out/pallas-$stamp.json"
-
-# no pipes around bench.py: `bench | tee` would report tee's status and a
-# mid-run crash (chip wedging after the probe passed) would masquerade as
-# success — the probe loop charges its revalidate cooldown off this
-# script's exit code. Write the artifact, then show it.
-echo "[revalidate] smoke shape (--quick)..." >&2
-python bench.py --quick > "$out/quick-$stamp.json"
-cat "$out/quick-$stamp.json"
-
-echo "[revalidate] north-star shape (1M x 100K, 61-bit)..." >&2
-python bench.py > "$out/northstar-$stamp.json"
-cat "$out/northstar-$stamp.json"
 
 echo "[revalidate] north-star with rbg generation (isolates threefry cost)..." >&2
 python bench.py --rng rbg --no-parity > "$out/northstar-rbg-$stamp.json"
