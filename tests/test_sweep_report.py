@@ -1,0 +1,75 @@
+"""scripts/sweep_report.py — the healthy-window sweep summarizer.
+
+The report feeds a real decision (which bench config becomes the
+default), so its parsing is worth pinning: artifact-name tag recovery,
+error-line exclusion, best-of-duplicates, and the full/overall
+recommendation split.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+_spec = importlib.util.spec_from_file_location(
+    "sweep_report",
+    pathlib.Path(__file__).resolve().parent.parent / "scripts" / "sweep_report.py",
+)
+sweep_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(sweep_report)
+
+
+def _write(d, name, obj):
+    (d / name).write_text(json.dumps(obj))
+
+
+def test_tag_recovery_and_grouping(tmp_path):
+    _write(tmp_path, "exp-threefry-c2000-20260731-050000.json",
+           {"value": 1e9, "steady_s": 20.0, "participants": 10})
+    _write(tmp_path, "exp-threefry-c2000-20260731-060000.json",
+           {"value": 3e9, "steady_s": 7.0, "participants": 10})  # best dup
+    _write(tmp_path, "exp-rbg-probe-20260731-050000.json",
+           {"value": 5e9, "rng": "rbg", "check": "probe", "partial": True})
+    _write(tmp_path, "exp-rbg-c500-20260731-050000.json",
+           {"value": 0, "error": "wedged"})  # error line: excluded
+    _write(tmp_path, "exp-broken-20260731.json", {})  # no value: excluded
+
+    rows = sweep_report.load(tmp_path)
+    assert len(rows) == 3
+    tags = {sweep_report.tag_of(r) for r in rows}
+    assert ("threefry", "2000", "full") in tags
+    assert ("rbg", None, "probe") in tags
+
+    best = {}
+    for r in rows:
+        key = sweep_report.tag_of(r)
+        if key not in best or r["value"] > best[key]["value"]:
+            best[key] = r
+    assert best[("threefry", "2000", "full")]["value"] == 3e9
+
+
+def test_main_recommends_full_and_overall(tmp_path, capsys):
+    _write(tmp_path, "exp-threefry-c8000-20260731-050000.json",
+           {"value": 4e9, "steady_s": 21.0})
+    _write(tmp_path, "exp-rbg-off-20260731-050000.json",
+           {"value": 9e9, "rng": "rbg", "check": "off", "steady_s": 9.0})
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    # the headline default must come from a full-check config even when a
+    # reduced-check variant is faster overall
+    assert "fastest full-check config: ('threefry', '8000', 'full')" in out
+    assert "fastest overall:           ('rbg', None, 'off')" in out
+
+
+def test_empty_dir_is_an_error(tmp_path):
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        assert sweep_report.main() == 1
+    finally:
+        sys.argv = old
