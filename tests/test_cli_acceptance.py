@@ -69,6 +69,9 @@ def test_bench_cpu_smoke_all_engines():
         ["--quick", "--check", "probe", "--dim", "2100"],
         ["--wide", "--check", "probe", "--dim", "2100"],
         ["--wide", "--check", "off"],
+        # the rbg generator variant tpu-revalidate.sh banks each window
+        # must stay runnable end-to-end, not just flag-parse
+        ["--wide", "--rng", "rbg"],
     ):
         out = subprocess.run(
             [
@@ -100,6 +103,8 @@ def test_bench_cpu_smoke_all_engines():
                 # dim 2100 -> stride 2 -> ceil(2100/2) covered columns;
                 # strictly fewer than dim proves the subset path ran
                 assert line["check_cols"] == 1050 < line["dim"]
+        if "--rng" in extra:
+            assert line["rng"] == extra[extra.index("--rng") + 1]
 
 
 def test_bench_deadline_emits_error_metric():
