@@ -1,0 +1,174 @@
+"""Randomized crash-injection soak over the real two-process deployment.
+
+Each round boots TWO ``sdad`` server processes on one shared sqlite store
+(the reference's multi-process deployment shape,
+server-store-mongodb/src/lib.rs:64-84), runs a full masked additive round
+through them over real REST, and SIGKILLs one server at a random point:
+
+  - phase ``participate``: after some participations have landed
+  - phase ``enqueue``:     right after end_aggregation enqueued the jobs
+  - phase ``clerking``:    after the first clerk already posted a result
+
+The victim is random (server A or B); every role then fails over to the
+survivor with the same identity and TOFU token. The round must still
+produce the exact modular sum and the store must pass integrity_check —
+the passive-resilience contract (delete-after-result job durability,
+jfs_stores/clerking_jobs.rs:51-59; result_ready gating, server.rs:115-121)
+under hard process death. The reference itself ships no fault-injection
+tests; this soak is the deployment-level complement to the fixed
+scenarios in tests/test_shared_store.py.
+
+Usage:  python scripts/crash_soak.py [N]     (default 10; ~8-15 s/round)
+Exit 0 = every round exact + integrity ok; 1 = any failure (seed printed).
+"""
+
+import os
+import pathlib
+import signal
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tests"))
+sys.path.insert(0, str(REPO))
+
+import numpy as np
+
+DIM = 24
+MODULUS = 1_000_003
+PHASES = ("participate", "enqueue", "clerking")
+
+
+def one_round(seed: int, tmp: pathlib.Path) -> None:
+    from sda_fixtures import new_client
+    from test_shared_store import (
+        _bound_port,
+        _http_client,
+        _integrity_ok,
+        _rebind,
+        _spawn_sdad,
+        _wait_ready,
+    )
+
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+
+    rng = np.random.default_rng(seed)
+    phase = PHASES[int(rng.integers(len(PHASES)))]
+    victim_ix = int(rng.integers(2))
+    n_parts = int(rng.integers(3, 7))
+
+    db = tmp / "shared.db"
+    procs = [_spawn_sdad(db), _spawn_sdad(db)]
+    try:
+        urls = []
+        for proc in procs:
+            port = _bound_port(proc)
+            _wait_ready(port, proc)
+            urls.append(f"http://127.0.0.1:{port}")
+        survivor_url = urls[1 - victim_ix]
+
+        def client(name, url):
+            c = new_client(tmp / name, _http_client(tmp / f"tok-{name}", url))
+            return c
+
+        recipient = client("recipient", urls[0])
+        rkey = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(rkey)
+        clerks = [client(f"clerk{i}", urls[i % 2]) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title=f"crash-soak-{seed}",
+            vector_dimension=DIM,
+            modulus=MODULUS,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=ChaChaMasking(
+                modulus=MODULUS, dimension=DIM, seed_bitsize=128
+            ),
+            committee_sharing_scheme=AdditiveSharing(
+                share_count=3, modulus=MODULUS
+            ),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+
+        vectors = rng.integers(0, MODULUS, size=(n_parts, DIM))
+
+        def kill_victim():
+            procs[victim_ix].send_signal(signal.SIGKILL)
+            procs[victim_ix].wait()
+
+        for i in range(n_parts):
+            # a mid-participation kill reroutes the remaining participants
+            if phase == "participate" and i == n_parts // 2:
+                kill_victim()
+            alive = [u for j, u in enumerate(urls) if procs[j].poll() is None]
+            part = client(f"part{i}", alive[i % len(alive)])
+            part.upload_agent()
+            part.participate(vectors[i].tolist(), agg.id)
+
+        recipient = _rebind(
+            recipient, _http_client(tmp / "tok-recipient", survivor_url)
+        )
+        recipient.end_aggregation(agg.id)
+        if phase == "enqueue":
+            kill_victim()
+
+        for i, c in enumerate(clerks):
+            if phase == "clerking" and i == 1:
+                kill_victim()
+            c = _rebind(c, _http_client(tmp / f"tok-clerk{i}", survivor_url))
+            c.run_chores(-1)
+        recipient.run_chores(-1)  # recipient may also hold committee jobs
+
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        want = vectors.sum(axis=0) % MODULUS
+        if not np.array_equal(np.asarray(out), want):
+            raise AssertionError(
+                f"aggregate mismatch (phase={phase}, victim={victim_ix})"
+            )
+        if not _integrity_ok(db):
+            raise AssertionError("sqlite integrity_check failed")
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+                proc.wait()
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    fails = []
+    for seed in range(n):
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                one_round(20_000 + seed, pathlib.Path(td))
+        except Exception as e:  # noqa: BLE001 — report and continue
+            fails.append(seed)
+            print(f"FAIL seed={20_000 + seed}: {e!r}", file=sys.stderr)
+        print(f"[crash-soak] round {seed + 1}/{n} done, {len(fails)} failures",
+              file=sys.stderr)
+    print(f"crash-soak: {n - len(fails)}/{n} randomized crash rounds exact")
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,6 +33,7 @@ import json
 import os
 import sqlite3
 import threading
+import time
 from contextlib import contextmanager
 from typing import Optional
 
@@ -120,7 +121,20 @@ class SqliteBackend:
                 path, check_same_thread=False, timeout=0, isolation_level=None
             )
             conn.execute(f"PRAGMA busy_timeout={int(BUSY_TIMEOUT_S * 1000)}")
-            conn.execute("PRAGMA journal_mode=WAL")
+            # the rollback->WAL transition takes an exclusive lock through
+            # a path that does NOT invoke the busy handler (observed: two
+            # sdad processes booting on one fresh file -> "database is
+            # locked" despite the busy_timeout above; scripts/crash_soak.py
+            # seed 20002), so the wait has to live here in a retry loop
+            deadline = time.monotonic() + BUSY_TIMEOUT_S
+            while True:
+                try:
+                    conn.execute("PRAGMA journal_mode=WAL")
+                    break
+                except sqlite3.OperationalError as exc:
+                    if "locked" not in str(exc) or time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.05)
             return conn
 
         self.conn = connect()

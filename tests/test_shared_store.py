@@ -497,3 +497,35 @@ def test_concurrent_participations_across_processes(tmp_path, two_servers):
     np.testing.assert_array_equal(
         output.positive().values, vectors.sum(axis=0) % MODULUS
     )
+
+
+def test_backend_boot_waits_out_rival_wal_transition(tmp_path):
+    """Two processes booting on one FRESH sqlite file race the
+    rollback->WAL journal-mode transition, whose exclusive lock skips the
+    busy handler — observed as a hard 'database is locked' sdad crash
+    (scripts/crash_soak.py seed 20002). The backend's boot-time retry must
+    wait out a rival that holds the database locked during init."""
+    import sqlite3
+
+    from sda_tpu.server.sqlstore import SqliteBackend
+
+    db = tmp_path / "fresh.db"
+    rival = sqlite3.connect(
+        str(db), isolation_level=None, check_same_thread=False
+    )
+    rival.execute("BEGIN EXCLUSIVE")  # still rollback-journal mode
+
+    def release():
+        time.sleep(0.5)
+        rival.execute("COMMIT")
+
+    t = threading.Thread(target=release)
+    t.start()
+    try:
+        backend = SqliteBackend(db)  # raised OperationalError before the fix
+        assert (
+            backend.conn.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+        )
+    finally:
+        t.join()
+        rival.close()
