@@ -928,8 +928,15 @@ def run(args: argparse.Namespace, watchdog) -> int:
             )
 
     # reconstruct + verify (any t+k of n clerks; drop one for the dropout path)
+    acc_host = np.asarray(acc).copy()
+    if os.environ.get("SDA_BENCH_INJECT_FAULT"):
+        # test hook: corrupt one accumulator cell so the acceptance suite
+        # can prove the verification below actually catches a broken
+        # fabric (exit 1 + error metric line), not just bless a good one
+        acc_host[(0,) * acc_host.ndim] += 1
+        print("[bench] FAULT INJECTED into the accumulator", file=sys.stderr)
     with stage("reconstruct + verify"):
-        got = finalize(np.asarray(acc), np.asarray(plain))
+        got = finalize(acc_host, np.asarray(plain))
     if got is None:
         print("VERIFICATION FAILED", file=sys.stderr)
         emit_error(

@@ -107,6 +107,31 @@ def test_bench_cpu_smoke_all_engines():
             assert line["rng"] == extra[extra.index("--rng") + 1]
 
 
+def test_bench_verification_catches_injected_fault():
+    """The self-verification must be able to FAIL, not just bless good
+    runs: with one accumulator cell corrupted via the SDA_BENCH_INJECT_FAULT
+    hook, the independent plaintext check has to reject the stream, exit 1,
+    and still print one well-formed error-tagged metric line."""
+    import json
+    import sys
+
+    repo, env = _cpu_bench_env()
+    env["SDA_BENCH_INJECT_FAULT"] = "1"
+    for extra in (["--quick"], ["--wide"]):  # narrow and pair check paths
+        out = subprocess.run(
+            [
+                sys.executable, "-S", str(repo / "bench.py"),
+                "--participants", "2000", "--dim", "60", "--chunk", "1000",
+                "--no-parity", *extra,
+            ],
+            capture_output=True, text=True, env=env, cwd=repo, timeout=240,
+        )
+        assert out.returncode == 1, (out.returncode, out.stderr[-500:])
+        assert "VERIFICATION FAILED" in out.stderr
+        line = json.loads(out.stdout.strip().splitlines()[-1])
+        assert line["value"] == 0 and "verification failed" in line["error"]
+
+
 def test_bench_deadline_emits_error_metric():
     """The pre-measurement watchdog contract: when nothing can be
     measured in time, bench still prints ONE well-formed, error-tagged
