@@ -8,6 +8,9 @@ through them over real REST, and SIGKILLs one server at a random point:
   - phase ``participate``: after some participations have landed
   - phase ``enqueue``:     right after end_aggregation enqueued the jobs
   - phase ``clerking``:    after the first clerk already posted a result
+  - phase ``restart``:     kill post-enqueue, then boot a COLD sdad onto
+    the store the dead writer left behind (stale WAL recovery + the
+    boot-lock race) and route the clerks through the newcomer
 
 The victim is random (server A or B); every role then fails over to the
 survivor with the same identity and TOFU token. The round must still
@@ -37,7 +40,7 @@ import numpy as np
 
 DIM = 24
 MODULUS = 1_000_003
-PHASES = ("participate", "enqueue", "clerking")
+PHASES = ("participate", "enqueue", "clerking", "restart")
 
 
 def one_round(seed: int, tmp: pathlib.Path) -> None:
@@ -127,6 +130,17 @@ def one_round(seed: int, tmp: pathlib.Path) -> None:
         recipient.end_aggregation(agg.id)
         if phase == "enqueue":
             kill_victim()
+        elif phase == "restart":
+            # the distinct recovery path: kill the victim AFTER jobs are
+            # enqueued, then boot a COLD process onto the store the dead
+            # writer left behind (stale WAL + possible boot-lock race with
+            # the survivor) and route the round through the newcomer
+            kill_victim()
+            newcomer = _spawn_sdad(db)
+            procs.append(newcomer)
+            port = _bound_port(newcomer)
+            _wait_ready(port, newcomer)
+            survivor_url = f"http://127.0.0.1:{port}"
 
         for i, c in enumerate(clerks):
             if phase == "clerking" and i == 1:
