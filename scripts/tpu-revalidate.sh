@@ -63,5 +63,11 @@ echo "[revalidate] north-star with rbg generation (isolates threefry cost)..." >
 python bench.py --rng rbg --no-parity > "$out/northstar-rbg-$stamp.json"
 cat "$out/northstar-rbg-$stamp.json"
 
+echo "[revalidate] participant engine (per-participant MXU share matmuls)..." >&2
+# the second engine's witnessed number (VERDICT r3 #1 asks for both):
+# materializes every share by design, so it runs the smaller smoke shape
+python bench.py --engine participant --no-parity > "$out/participant-$stamp.json"
+cat "$out/participant-$stamp.json"
+
 echo "[revalidate] done; artifacts in $out/ — update README.md/docs/tpu.md" \
      "provenance notes with these numbers" >&2
