@@ -69,5 +69,13 @@ echo "[revalidate] participant engine (per-participant MXU share matmuls)..." >&
 python bench.py --engine participant --no-parity > "$out/participant-$stamp.json"
 cat "$out/participant-$stamp.json"
 
+echo "[revalidate] participant engine, fused Pallas limb kernel..." >&2
+# same shape through parallel/limb_pallas.py: does the hand-written
+# kernel beat XLA's own fusion on silicon? (compile+parity alone is
+# proven by the smoke; this is the rate comparison)
+python bench.py --engine participant --pallas --no-parity \
+    > "$out/participant-pallas-$stamp.json"
+cat "$out/participant-pallas-$stamp.json"
+
 echo "[revalidate] done; artifacts in $out/ — update README.md/docs/tpu.md" \
      "provenance notes with these numbers" >&2
