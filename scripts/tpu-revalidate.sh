@@ -66,7 +66,11 @@ cat "$out/northstar-rbg-$stamp.json"
 echo "[revalidate] participant engine (per-participant MXU share matmuls)..." >&2
 # the second engine's witnessed number (VERDICT r3 #1 asks for both):
 # materializes every share by design, so it runs the smaller smoke shape
-python bench.py --engine participant --no-parity > "$out/participant-$stamp.json"
+# non-fatal (|| below): these run last and are the least-proven on
+# silicon — a failure must not void the already-banked artifacts above
+# (a nonzero exit would skip the probe loop's sweep + auto-commit)
+python bench.py --engine participant --no-parity > "$out/participant-$stamp.json" \
+    || echo "[revalidate] participant engine FAILED (artifact saved)" >&2
 cat "$out/participant-$stamp.json"
 
 echo "[revalidate] participant engine, fused Pallas limb kernel..." >&2
@@ -74,7 +78,8 @@ echo "[revalidate] participant engine, fused Pallas limb kernel..." >&2
 # kernel beat XLA's own fusion on silicon? (compile+parity alone is
 # proven by the smoke; this is the rate comparison)
 python bench.py --engine participant --pallas --no-parity \
-    > "$out/participant-pallas-$stamp.json"
+    > "$out/participant-pallas-$stamp.json" \
+    || echo "[revalidate] participant --pallas FAILED (artifact saved)" >&2
 cat "$out/participant-pallas-$stamp.json"
 
 echo "[revalidate] done; artifacts in $out/ — update README.md/docs/tpu.md" \
