@@ -23,9 +23,14 @@ Plus ``sumfirst-1m``: a genuine 1M-participant sum-first run (dim 1024,
 61-bit) exercising the documented int64 exactness bound
 (parallel/sumfirst.py MAX_PARTICIPANTS) on host, bit-verified.
 
-Usage: python scripts/baseline_ladder.py [--out FILE] [--quick]
+Usage: python scripts/baseline_ladder.py [--out FILE] [--quick] [--device]
 ``--quick`` divides participant counts by 100 (CI smoke; recorded as
-such). Writes one JSON artifact and prints it.
+such). ``--device`` (VERDICT r4 #4) runs configs 2-4 through the TPU
+aggregation-fabric engines on the *ambient* JAX backend instead of the
+host protocol loop — the math plane each config's scheme defines
+(additive / basic-Shamir / packed-Shamir share arithmetic on device),
+labeled as such; sealed transport stays priced by the host rows. Writes
+one JSON artifact and prints it.
 """
 
 from __future__ import annotations
@@ -44,14 +49,36 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tests"))
 # host ladder: force the CPU backend BEFORE any jax import — setdefault
 # would keep an ambient JAX_PLATFORMS=axon and block the whole ladder on
 # a wedged tunnel (this artifact must never depend on device health).
-# SDA_LADDER_PLATFORM overrides for an on-device ladder run.
-os.environ["JAX_PLATFORMS"] = os.environ.get("SDA_LADDER_PLATFORM", "cpu")
+# SDA_LADDER_PLATFORM overrides; --device keeps the ambient backend (the
+# axon chip under the driver env, CPU in the rehearsal) — checked here,
+# before argparse, because the jax platform must be pinned pre-import.
+if "--device" not in sys.argv:
+    os.environ["JAX_PLATFORMS"] = os.environ.get("SDA_LADDER_PLATFORM", "cpu")
+elif "SDA_LADDER_PLATFORM" in os.environ:
+    os.environ["JAX_PLATFORMS"] = os.environ["SDA_LADDER_PLATFORM"]
 
 import numpy as np
 
 from sda_tpu.ops.jaxcfg import sync_platform_to_env
 
 sync_platform_to_env()
+
+#: per-config wall-clock budget (seconds) for the --device fabric loops,
+#: checked COOPERATIVELY between chunks: a slow-but-healthy chip stops
+#: early with a verified partial result instead of being SIGKILLed by an
+#: external timeout mid-device-op (which can wedge the tunneled chip for
+#: hours). None = unlimited (host mode keeps its historical semantics).
+_DEVICE_BUDGET: float | None = None
+
+
+def _budget_spent(t0: float, done: int) -> bool:
+    """True when the device budget is spent and at least one chunk landed
+    (a partial-but-verified result beats an unverifiable empty one)."""
+    return (
+        _DEVICE_BUDGET is not None
+        and done > 0
+        and time.perf_counter() - t0 > _DEVICE_BUDGET
+    )
 
 
 def _client(tmp, name, service):
@@ -257,7 +284,7 @@ def config4(n_participants: int) -> dict:
     acc = None
     plain = np.zeros(dim, dtype=np.int64)
     done = 0
-    while done < n_participants:
+    while done < n_participants and not _budget_spent(t0, done):
         c = min(chunk, n_participants - done)
         secrets = rng.integers(0, p, size=(c, dim))
         key, sub = jax.random.split(key)
@@ -275,16 +302,155 @@ def config4(n_participants: int) -> dict:
     out = reconstruct_from_clerk_sums(clerk_sums, survivors, scheme, dim)
     wall = time.perf_counter() - t0
     got = positive(np.asarray(out), p)
-    return {
+    entry = {
         "config": f"4: packed Shamir dropout, dim 50K, {n_participants} "
                   "participants (sum-first fabric)",
+        "backend": jax.devices()[0].platform,
         "wall_s": round(wall, 3),
-        "participants": n_participants,
-        "elements": n_participants * dim,
-        "elements_per_s": round(n_participants * dim / wall, 1),
+        "participants": done,
+        "elements": done * dim,
+        "elements_per_s": round(done * dim / wall, 1),
         "verified": bool(np.array_equal(got, plain % p)),
         "dropped_clerk_row": 3,
     }
+    if done < n_participants:
+        entry["partial"] = True
+    return entry
+
+
+def config2_device(n_participants: int) -> dict:
+    """Config 2's math plane on the device fabric: additive 3-way share
+    generation (n-1 draws + closing share, additive.rs:42-48 semantics)
+    for every participant on device, clerk-combine, additive
+    reconstruction — streamed in chunks, verified against an independent
+    host plaintext sum. The host config-2 row prices sealed transport;
+    this row prices the share arithmetic itself at the same shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from sda_tpu.ops.jaxcfg import ensure_x64
+    from sda_tpu.ops.modular import positive
+    from sda_tpu.parallel.engine import (
+        clerk_combine_mod,
+        make_plan,
+        reconstruct,
+        share_participants,
+    )
+    from sda_tpu.protocol import AdditiveSharing
+
+    ensure_x64()
+    dim, p = 100_000, 4294967291  # same shape/modulus as the host row
+    scheme = AdditiveSharing(share_count=3, modulus=p)
+    plan = make_plan(scheme, dim)
+    chunk = min(500, n_participants)
+    rng = np.random.default_rng(12)
+    key = jax.random.key(21)
+
+    @jax.jit
+    def step(acc, secrets, key):
+        shares = share_participants(secrets, key, plan)  # (C, n, B)
+        return lax.rem(acc + clerk_combine_mod(shares, p), jnp.int64(p))
+
+    t0 = time.perf_counter()
+    acc = jnp.zeros((scheme.share_count, dim), dtype=jnp.int64)
+    plain = np.zeros(dim, dtype=np.int64)
+    done = 0
+    while done < n_participants and not _budget_spent(t0, done):
+        c = min(chunk, n_participants - done)
+        secrets = rng.integers(0, p, size=(c, dim))
+        key, sub = jax.random.split(key)
+        acc = step(acc, jnp.asarray(secrets), sub)
+        plain += secrets.sum(axis=0)  # exact: n_participants * p < 2^63
+        done += c
+    got = positive(np.asarray(reconstruct(acc, range(3), scheme, dim)), p)
+    wall = time.perf_counter() - t0
+    out = {
+        "config": f"2-device: additive-3 share fabric, dim 100K, "
+                  f"{n_participants} participants, 32-bit",
+        "plane": "device-fabric (share arithmetic; transport priced by the host row)",
+        "backend": jax.devices()[0].platform,
+        "wall_s": round(wall, 3),
+        "participants": done,
+        "elements": done * dim,
+        "elements_per_s": round(done * dim / wall, 1),
+        "verified": bool(np.array_equal(got, plain % p)),
+    }
+    if done < n_participants:
+        out["partial"] = True
+    return out
+
+
+def config3_device(n_participants: int) -> dict:
+    """Config 3's math plane on the device fabric: basic-Shamir t=2 n=5
+    share matmuls via the fused int8-limb path (share_combine_limb), a
+    streamed participant reduction, device Lagrange reconstruction from
+    a strict 3-of-5 survivor subset (the dropout bound the trust shape
+    promises). Verified against an independent host plaintext sum."""
+    import jax
+    import jax.numpy as jnp
+
+    from sda_tpu.ops.jaxcfg import ensure_x64
+    from sda_tpu.ops.modular import positive
+    from sda_tpu.parallel.engine import (
+        make_plan,
+        reconstruct,
+        share_combine_limb,
+    )
+    from sda_tpu.parallel.limbmatmul import limb_recombine
+    from sda_tpu.protocol import BasicShamirSharing
+
+    ensure_x64()
+    t, n = 2, 5
+    p = 1048583  # same 21-bit prime as the host row
+    scheme = BasicShamirSharing(share_count=n, privacy_threshold=t,
+                                prime_modulus=p)
+    dim = 10_000
+    plan = make_plan(scheme, dim)
+    chunk = min(2_000, n_participants)
+    rng = np.random.default_rng(13)
+    key = jax.random.key(22)
+
+    @jax.jit
+    def step(secrets, key):
+        # weight-grouped limb partials summed over the chunk's
+        # participants; plain + across chunks is exact while
+        # total_participants * L*K*127^2 < 2^63 (here ~1e10)
+        return share_combine_limb(secrets, key, plan)
+
+    t0 = time.perf_counter()
+    acc = None
+    plain = np.zeros(dim, dtype=np.int64)
+    done = 0
+    while done < n_participants and not _budget_spent(t0, done):
+        c = min(chunk, n_participants - done)
+        secrets = rng.integers(0, p, size=(c, dim))
+        key, sub = jax.random.split(key)
+        a = step(jnp.asarray(secrets), sub)
+        acc = a if acc is None else acc + a
+        plain += secrets.sum(axis=0)
+        done += c
+    clerk_sums = jnp.swapaxes(limb_recombine(acc, p), 0, 1)  # (n, B)
+    survivors = [0, 2, 4]  # strict t+1=3 of 5: Lagrange on device
+    got = positive(
+        np.asarray(reconstruct(clerk_sums, survivors, scheme, dim)), p
+    )
+    wall = time.perf_counter() - t0
+    out = {
+        "config": f"3-device: basic-Shamir t=2 n=5 limb-MXU fabric, dim 10K, "
+                  f"{n_participants} participants",
+        "plane": "device-fabric (share arithmetic; transport priced by the host row)",
+        "backend": jax.devices()[0].platform,
+        "wall_s": round(wall, 3),
+        "participants": done,
+        "elements": done * dim,
+        "elements_per_s": round(done * dim / wall, 1),
+        "verified": bool(np.array_equal(got, plain % p)),
+        "survivor_subset": survivors,
+    }
+    if done < n_participants:
+        out["partial"] = True
+    return out
 
 
 def sumfirst_1m(n_participants: int) -> dict:
@@ -353,21 +519,80 @@ def main() -> int:
     parser.add_argument("--out", default=None)
     parser.add_argument("--quick", action="store_true",
                         help="participant counts / 100 (smoke)")
-    parser.add_argument("--configs", default="1,2,3,4,sumfirst-1m",
-                        help="comma-separated subset to run")
+    parser.add_argument("--configs", default=None,
+                        help="comma-separated subset to run (default: all "
+                        "host configs; with --device: 2,3,4)")
+    parser.add_argument("--device", action="store_true",
+                        help="route configs 2-4 through the TPU fabric "
+                        "engines on the ambient JAX backend (VERDICT r4 "
+                        "#4); config 4 is the same fabric code either "
+                        "way, just not pinned to CPU")
     args = parser.parse_args()
     div = 100 if args.quick else 1
+    if args.configs is None:
+        args.configs = "2,3,4" if args.device else "1,2,3,4,sumfirst-1m"
+    results = {"quick": args.quick, "device": args.device, "configs": []}
+    arm_config_watchdog = None
+    if args.device:
+        # host-only rows must stay host rows: config 1 and sumfirst-1m
+        # have no device analog (and no budget/partial support), and the
+        # module header's promise that the HOST ladder never depends on
+        # device health would silently break if they ran on the ambient
+        # backend here.
+        device_ok = {"2", "3", "4"}
+        bad = [c for c in args.configs.split(",") if c.strip() not in device_ok]
+        if bad:
+            parser.error(
+                f"--device supports configs 2,3,4 only (got {','.join(bad)}); "
+                "run host-only configs without --device"
+            )
+        # cooperative per-config budget (between-chunk checks; see
+        # _DEVICE_BUDGET) + a last-resort wedge watchdog re-armed before
+        # every config: if a native device call blocks past
+        # SDA_LADDER_DEADLINE the chip is wedged (a healthy-but-slow
+        # config stops at its cooperative budget long before), so dump
+        # the configs finished so far and exit — never leave the probe
+        # loop hostage, never require an external SIGKILL. Per-config
+        # arming keeps the deadline from accumulating across configs:
+        # three slow-but-healthy configs must not eat config 4's slot.
+        global _DEVICE_BUDGET
+        _DEVICE_BUDGET = float(os.environ.get("SDA_LADDER_BUDGET", "300"))
+        deadline = float(os.environ.get("SDA_LADDER_DEADLINE", "900"))
+
+        def _wedged():
+            results["watchdog"] = (
+                f"deadline {deadline:.0f}s hit (device wedged mid-config?); "
+                "partial results dumped"
+            )
+            payload = json.dumps(results, indent=1)
+            print(payload)
+            if args.out:
+                Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+                Path(args.out).write_text(payload + "\n")
+            os._exit(3)
+
+        import threading
+
+        wd_box = [None]
+
+        def arm_config_watchdog():
+            if wd_box[0] is not None:
+                wd_box[0].cancel()
+            wd_box[0] = threading.Timer(deadline, _wedged)
+            wd_box[0].daemon = True
+            wd_box[0].start()
     runners = {
         "1": lambda: config1(),
-        "2": lambda: config2(1_000 // div),
-        "3": lambda: config3(10_000 // div),
+        "2": lambda: (config2_device if args.device else config2)(1_000 // div),
+        "3": lambda: (config3_device if args.device else config3)(10_000 // div),
         "4": lambda: config4(100_000 // div),
         "sumfirst-1m": lambda: sumfirst_1m(1_000_000 // div),
     }
-    results = {"quick": args.quick, "configs": []}
     for name in args.configs.split(","):
         name = name.strip()
         print(f"[ladder] running config {name}...", file=sys.stderr, flush=True)
+        if arm_config_watchdog is not None:
+            arm_config_watchdog()
         t0 = time.perf_counter()
         try:
             entry = runners[name]()

@@ -31,6 +31,7 @@ def load(artdir: pathlib.Path):
                 "artifact": f.name,
                 "rng": d.get("rng", "threefry"),
                 "check": d.get("check", "full"),
+                "chunk": d.get("chunk"),
                 "value": d["value"],
                 "steady_s": d.get("steady_s"),
                 "partial": bool(d.get("partial")),
@@ -42,11 +43,17 @@ def load(artdir: pathlib.Path):
 
 
 def tag_of(row):
-    # chunk is not in the metric line; recover it from the artifact tag
-    # (exp-<rng>-c<chunk>-<stamp>.json / exp-<rng>-<check>-<stamp>.json)
-    parts = row["artifact"].split("-")
-    chunk = next((p[1:] for p in parts if p.startswith("c") and p[1:].isdigit()), None)
-    return row["rng"], chunk, row["check"]
+    # chunk: prefer the metric line (bench.py records it since r5 — the
+    # check-variant artifacts then group under their real default chunk
+    # instead of chunk=None, ADVICE r4 #2); filename tag as fallback for
+    # pre-r5 artifacts (exp-<rng>-c<chunk>-<stamp>.json)
+    chunk = row.get("chunk")
+    if chunk is None:
+        parts = row["artifact"].split("-")
+        chunk = next(
+            (p[1:] for p in parts if p.startswith("c") and p[1:].isdigit()), None
+        )
+    return row["rng"], str(chunk) if chunk is not None else None, row["check"]
 
 
 def main() -> int:

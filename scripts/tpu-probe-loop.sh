@@ -8,14 +8,14 @@
 # charged when revalidate actually completes — an immediate "device
 # unreachable" abort must not burn an hour against the next rare window.
 #
-# Usage: sh scripts/tpu-probe-loop.sh [logfile]   (default PROBE_r04.log)
+# Usage: sh scripts/tpu-probe-loop.sh [logfile]   (default PROBE_r05.log)
 # Runs until killed. Intended to run in the background for a whole session:
 #   nohup sh scripts/tpu-probe-loop.sh &
 # Single-instance: a second copy probing mid-bench can perturb or wedge the
 # measurement, so startup is guarded by a lock directory.
 set -u
 cd "$(dirname "$0")/.."
-LOG="${1:-PROBE_r04.log}"
+LOG="${1:-PROBE_r05.log}"
 INTERVAL="${INTERVAL:-600}"
 REVALIDATE_COOLDOWN="${REVALIDATE_COOLDOWN:-3600}"
 LOCKDIR="${TMPDIR:-/tmp}/sda-tpu-probe-loop.lock"
@@ -28,7 +28,10 @@ if ! mkdir "$LOCKDIR" 2>/dev/null; then
     # pre-pid-file instance — either way, assume live and stand down;
     # evicting a live loop would put two probers on the chip at once.
     holder=$(cat "$LOCKDIR/pid" 2>/dev/null)
-    if [ -z "$holder" ] || kill -0 "$holder" 2>/dev/null; then
+    # existence check via /proc, not kill -0: kill -0 also fails with
+    # EPERM on a LIVE process under another uid, which would reclaim a
+    # live holder's lock and put two probe loops on the chip at once
+    if [ -z "$holder" ] || [ -d "/proc/$holder" ]; then
         echo "tpu-probe-loop: ${holder:-unknown pid} holds $LOCKDIR; exiting" >&2
         exit 1
     fi
@@ -79,11 +82,14 @@ while :; do
     # wedge exactly the measurement that matters most, so stand down.
     # While revalidate runs, this loop is blocked inside it — any bench
     # visible at probe time is foreign by construction.
-    # anchored: first argv token must BE a python interpreter, then any
-    # interpreter flags (-S, -u, -X foo...), then the script bench.py —
-    # a loose ".*bench\.py" would also match the build driver's own
-    # cmdline (its prompt text mentions bench.py)
-    if pgrep -f "^[^ ]*python[0-9.]*( -[^ ]+)* [^ ]*bench\.py" >/dev/null 2>&1; then
+    # anchored: first argv token must BE a python interpreter (optionally
+    # via `env python`), then any interpreter flags (-S, -u, -X foo...),
+    # then the script bench.py — a loose ".*bench\.py" would also match
+    # the build driver's own cmdline (its prompt text mentions bench.py).
+    # `sh -c 'python bench.py'` is covered via the python child process
+    # it spawns; a never-exec'd wrapper shape remains best-effort (TOCTOU
+    # is inherent to any check-then-probe scheme).
+    if pgrep -f "^([^ ]*env +)?[^ ]*python[0-9.]*( -[^ ]+)* [^ ]*bench\.py" >/dev/null 2>&1; then
         echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) skip probe: foreign bench.py running" >> "$LOG"
         sleep "$INTERVAL"
         continue

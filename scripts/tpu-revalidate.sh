@@ -11,6 +11,14 @@ out="${1:-bench-artifacts}"
 mkdir -p "$out"
 stamp=$(date +%Y%m%d-%H%M%S)
 
+# CPU dress rehearsal (VERDICT r4 #1): SDA_REVALIDATE_SMOKE=1 shrinks
+# every bench call so the whole banking chain — ordering, flags,
+# artifact paths — runs in minutes without a chip. Run it after every
+# chain edit: a healthy window must never be spent debugging banking.
+#   SDA_REVALIDATE_SMOKE=1 JAX_PLATFORMS=cpu sh scripts/tpu-revalidate.sh /tmp/reh
+SMOKE="${SDA_REVALIDATE_SMOKE:+--participants 3000 --dim 800 --chunk 500 --segments 3}"
+LADDER_SMOKE="${SDA_REVALIDATE_SMOKE:+--quick}"
+
 # a chip that wedges *mid-revalidate* (after the cheap probe passed) must
 # not hold the window hostage for bench.py's default 50-minute deadline:
 # healthy-path pre-measurement time is ~80 s (parity ~70 s + compile), so
@@ -33,21 +41,51 @@ if ! sh scripts/tpu-probe.sh 150 >&2; then
 fi
 
 # Banking order is value order — observed windows can close in ~4 min
-# (PROBE_r04.log 03:18 UTC), so the headline artifact goes FIRST:
-#   1. north-star with full parity riders (THE number + on-device parity)
-#   2. quick smoke, parity skipped (the north-star's rider just covered it)
-#   3. pallas compile/parity/throughput smoke
-#   4. rbg north-star (isolates threefry generation cost)
+# (PROBE_r04.log 03:18 UTC). The r4 verdict ranks the MISSING evidence
+# first: the participant engine (the real protocol-plane path,
+# client/src/participate.rs:37-113 analog) has never been witnessed on
+# silicon, while the sum-first north-star has two banked artifacts. So:
+#   1. participant engine, smoke shape (fast, guaranteed early bank)
+#   2. participant engine, fused Pallas limb kernel (XLA-vs-Pallas rate)
+#   3. north-star with parity riders + roofline decomposition (targets
+#      the observed-best 14.6 s, docs/tpu.md)
+#   4. participant engine at the north-star shape, budget-capped (the
+#      "largest shape that fits" number; ~10x slower by design)
+#   5. quick smoke, pallas smoke, rbg north-star
 # No pipes around bench.py: `bench | tee` would report tee's status and a
 # mid-run crash (chip wedging after the probe passed) would masquerade as
 # success — the probe loop charges its revalidate cooldown off this
 # script's exit code. Write the artifact, then show it.
-echo "[revalidate] north-star shape (1M x 100K, 61-bit)..." >&2
-python bench.py > "$out/northstar-$stamp.json"
+# Engine-specific artifacts are non-fatal (||): a failure must not void
+# the window for everything after it — but the FIRST artifact failing
+# fails the script so the loop doesn't charge its cooldown on nothing.
+echo "[revalidate] participant engine (per-participant MXU share matmuls)..." >&2
+python bench.py --engine participant --no-parity $SMOKE > "$out/participant-$stamp.json"
+cat "$out/participant-$stamp.json"
+
+echo "[revalidate] participant engine, fused Pallas limb kernel..." >&2
+# same shape through parallel/limb_pallas.py: does the hand-written
+# kernel beat XLA's own fusion on silicon? (compile+parity alone is
+# proven by the smoke; this is the rate comparison)
+python bench.py --engine participant --pallas --no-parity $SMOKE \
+    > "$out/participant-pallas-$stamp.json" \
+    || echo "[revalidate] participant --pallas FAILED (artifact saved)" >&2
+cat "$out/participant-pallas-$stamp.json"
+
+echo "[revalidate] north-star shape (1M x 100K, 61-bit) + roofline..." >&2
+python bench.py --roofline $SMOKE > "$out/northstar-$stamp.json" \
+    || echo "[revalidate] north-star FAILED (artifact saved)" >&2
 cat "$out/northstar-$stamp.json"
 
+echo "[revalidate] participant engine at the north-star shape (budget-capped)..." >&2
+python bench.py --engine participant --northstar --budget 240 --no-parity $SMOKE \
+    > "$out/participant-northstar-$stamp.json" \
+    || echo "[revalidate] participant north-star FAILED (artifact saved)" >&2
+cat "$out/participant-northstar-$stamp.json"
+
 echo "[revalidate] smoke shape (--quick, parity covered above)..." >&2
-python bench.py --quick --no-parity > "$out/quick-$stamp.json"
+python bench.py --quick --no-parity $SMOKE > "$out/quick-$stamp.json" \
+    || echo "[revalidate] quick smoke FAILED (artifact saved)" >&2
 cat "$out/quick-$stamp.json"
 
 echo "[revalidate] pallas kernel compile + parity + throughput smoke..." >&2
@@ -60,27 +98,22 @@ fi
 cat "$out/pallas-$stamp.json"
 
 echo "[revalidate] north-star with rbg generation (isolates threefry cost)..." >&2
-python bench.py --rng rbg --no-parity > "$out/northstar-rbg-$stamp.json"
+python bench.py --rng rbg --no-parity $SMOKE > "$out/northstar-rbg-$stamp.json" \
+    || echo "[revalidate] rbg north-star FAILED (artifact saved)" >&2
 cat "$out/northstar-rbg-$stamp.json"
 
-echo "[revalidate] participant engine (per-participant MXU share matmuls)..." >&2
-# the second engine's witnessed number (VERDICT r3 #1 asks for both):
-# materializes every share by design, so it runs the smaller smoke shape
-# non-fatal (|| below): these run last and are the least-proven on
-# silicon — a failure must not void the already-banked artifacts above
-# (a nonzero exit would skip the probe loop's sweep + auto-commit)
-python bench.py --engine participant --no-parity > "$out/participant-$stamp.json" \
-    || echo "[revalidate] participant engine FAILED (artifact saved)" >&2
-cat "$out/participant-$stamp.json"
-
-echo "[revalidate] participant engine, fused Pallas limb kernel..." >&2
-# same shape through parallel/limb_pallas.py: does the hand-written
-# kernel beat XLA's own fusion on silicon? (compile+parity alone is
-# proven by the smoke; this is the rate comparison)
-python bench.py --engine participant --pallas --no-parity \
-    > "$out/participant-pallas-$stamp.json" \
-    || echo "[revalidate] participant --pallas FAILED (artifact saved)" >&2
-cat "$out/participant-pallas-$stamp.json"
+echo "[revalidate] device-mode baseline ladder (configs 2-4 on the chip)..." >&2
+# VERDICT r4 #4: config 4 took 712.9 s on host — the exact shape the TPU
+# fabric exists for; bank the device-mode ladder columns in a window.
+# The ladder guards the probe loop itself: a cooperative per-config
+# budget (SDA_LADDER_BUDGET) stops slow-but-healthy runs with verified
+# partial results, and an internal wedge watchdog (SDA_LADDER_DEADLINE)
+# dumps-and-exits if a native call blocks — no external SIGKILL, which
+# could wedge a HEALTHY chip mid-device-op.
+python scripts/baseline_ladder.py --device --configs 2,3,4 $LADDER_SMOKE \
+    --out "$out/ladder-device-$stamp.json" >/dev/null \
+    || echo "[revalidate] device ladder FAILED (artifact saved)" >&2
+cat "$out/ladder-device-$stamp.json" 2>/dev/null || true
 
 echo "[revalidate] done; artifacts in $out/ — update README.md/docs/tpu.md" \
      "provenance notes with these numbers" >&2

@@ -184,6 +184,17 @@ def mod_sum_auto_jnp(x, m, axis: int = 0):
     fastest); past it the halving mod-sum takes over. Every reduced
     modular reduction in the engine routes through here so the bound
     logic lives in exactly one place.
+
+    Signed-representative caveat: for MIXED-SIGN input (additive closing
+    shares can be negative — truncated-remainder Rust semantics) the two
+    paths can return *different signed representatives of the same
+    residue*: sum-then-rem carries one signed remainder of the total,
+    while the pairwise-rem tree re-signs at every level. Both are the
+    correct residue mod m; only canonicalization (``positive``) makes
+    them bit-identical, and everything downstream does exactly that
+    (pinned by tests/test_wide_modulus.py::test_mixed_sign_residue_
+    equality_across_paths). For all-nonnegative input the narrow path's
+    result is canonical already.
     """
     if x.shape[axis] * (m - 1) < 2**63:
         return mod_sum_jnp(x, m, axis)

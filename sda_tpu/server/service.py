@@ -317,8 +317,16 @@ class SdaServer:
             raise InvalidCredentialsError("agent already registered")
 
     def check_auth_token(self, token):
+        import hmac
+
         stored = self.auth_tokens_store.get_auth_token(token.id)
-        if stored is not None and stored == token:
+        # constant-time secret compare (VERDICT r4 #7): a `==` on the token
+        # body leaks a prefix-length timing oracle on a network-facing auth
+        # path. The reference itself compares with == (server.rs:174-186);
+        # this is a deliberate hardening deviation (docs/security.md).
+        if stored is not None and hmac.compare_digest(
+            str(stored.body).encode(), str(token.body).encode()
+        ):
             agent = self.agents_store.get_agent(token.id)
             if agent is None:
                 raise InvalidCredentialsError("Agent not found")

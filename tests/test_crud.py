@@ -100,6 +100,29 @@ def test_auth_tokens_crud():
                 server.check_auth_token(t)
 
 
+def test_auth_token_compare_is_constant_time(tmp_path):
+    """VERDICT r4 #7: the token-body comparison on the network-facing
+    auth path must be hmac.compare_digest, not `==` (the reference's
+    server.rs:174-186 shape leaks a prefix-length timing oracle — this
+    repo deviates deliberately, docs/security.md). Pins the primitive
+    statically and the behavior on the prefix-oracle case: a same-length
+    token differing only in the final byte is rejected."""
+    import inspect
+
+    from sda_tpu.server.service import SdaServer
+
+    src = inspect.getsource(SdaServer.check_auth_token)
+    assert "compare_digest" in src, "auth compare regressed to =="
+    with with_service() as ctx:
+        server = ctx.server.server
+        alice = new_agent()
+        ctx.server.create_agent(alice, alice)
+        server.upsert_auth_token(Labelled(alice.id, "secret-token-A"))
+        with pytest.raises(InvalidCredentialsError):
+            server.check_auth_token(Labelled(alice.id, "secret-token-B"))
+        assert server.check_auth_token(Labelled(alice.id, "secret-token-A")) == alice
+
+
 def test_aggregation_crud():
     with with_service() as ctx:
         alice, alice_key = new_full_agent(ctx.service)
