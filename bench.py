@@ -231,6 +231,17 @@ def measure_crypto_plane() -> dict:
     out["opens_per_s"] = round(n_seal / (time.perf_counter() - t0))
     assert opened[0] == msg
 
+    # message-size ladder: protocol seals carry varint share VECTORS
+    # (~40 KB at dim 10K), not 64-byte probes — the size rows price the
+    # gap between the microbench rate and in-context ladder rates
+    # (e.g. LADDER config 3's ~883 seals/s), which is XSalsa20 bulk
+    # throughput, not per-seal overhead
+    for size, tag, cnt in ((4096, "_4k", 500), (40960, "_40k", 150)):
+        big = b"\x37" * size
+        t0 = time.perf_counter()
+        native.seal_batch([big] * cnt, pk)
+        out[f"seals_per_s{tag}"] = round(cnt / (time.perf_counter() - t0))
+
     n_scalar = 300
     t0 = time.perf_counter()
     for _ in range(n_scalar):

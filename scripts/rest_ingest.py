@@ -1,0 +1,192 @@
+"""Measured REST + sqlite ingest at >= 100K participations (VERDICT r4 #6).
+
+The server-side ingest choke point in the reference is the store write
+path (jfs: server/src/stores.rs:86-101; mongo: aggregations.rs:164-195);
+here it is ``rest/server.py``'s threaded handler over
+``server/sqlstore.py`` (WAL). ``bench.py``'s rest-ingest rider measures
+300 posts against the mem store — enough for a rate estimate, not for
+sustained-ingest evidence. This script replays the canonical transcript
+setup (fixed identities, tests/replay_transcript.py) against a live
+loopback HTTP server, hammers N fresh participation POSTs from
+``--threads`` keep-alive connections, verifies every response status AND
+the stored row count afterwards, and writes one JSON artifact with the
+measured participations/s — replacing the projection row in
+docs/tpu.md's 1M budget table with a measurement for the server side.
+
+Usage: python scripts/rest_ingest.py [--n 100000] [--threads 4]
+         [--backend sqlite|mem|file] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import http.client
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tests"))
+
+from replay_transcript import TRANSCRIPT  # noqa: E402
+
+from sda_tpu.protocol import AggregationId  # noqa: E402
+from sda_tpu.rest.server import serve_background  # noqa: E402
+from sda_tpu.server import (  # noqa: E402
+    new_file_server,
+    new_mem_server,
+    new_sqlite_server,
+)
+
+
+def _headers(step, body):
+    headers = {}
+    if step["auth"]:
+        agent, pw = step["auth"]
+        headers["Authorization"] = "Basic " + base64.b64encode(
+            f"{agent}:{pw}".encode()
+        ).decode()
+    if body:
+        headers["Content-Type"] = "application/json"
+    return headers
+
+
+def _replay_setup(conn, steps):
+    """Replay the transcript prefix (agents, keys, aggregation,
+    committee) on one connection; statuses must match the recording."""
+    for step in steps:
+        body = (step["request_body"] or "").encode() or None
+        conn.request(step["method"], step["path"], body=body,
+                     headers=_headers(step, body))
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == step["status"], (step["label"], resp.status)
+
+
+def _post_slice(host, step, bodies, results, ix):
+    """One worker: own keep-alive connection, POST every body, count
+    accepted statuses (anything else fails the run loudly)."""
+    conn = http.client.HTTPConnection(host, timeout=60)
+    ok = 0
+    t0 = time.perf_counter()
+    try:
+        for body in bodies:
+            data = body.encode()
+            conn.request(step["method"], step["path"], body=data,
+                         headers=_headers(step, data))
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status in (200, 201):
+                ok += 1
+            else:
+                raise AssertionError(
+                    f"worker {ix}: POST status {resp.status} after {ok} ok"
+                )
+    finally:
+        results[ix] = {"ok": ok, "wall_s": time.perf_counter() - t0}
+        conn.close()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=100_000)
+    parser.add_argument("--threads", type=int, default=4)
+    parser.add_argument("--backend", choices=("sqlite", "mem", "file"),
+                        default="sqlite")
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    by_label = {s["label"]: s for s in TRANSCRIPT}
+    part_step = by_label["part-1 participates"]
+    prefix = TRANSCRIPT[: TRANSCRIPT.index(part_step)]
+    template = json.loads(part_step["request_body"])
+    agg_id = AggregationId(template["aggregation"])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        service = {
+            "sqlite": lambda: new_sqlite_server(os.path.join(tmp, "db")),
+            "file": lambda: new_file_server(os.path.join(tmp, "files")),
+            "mem": new_mem_server,
+        }[args.backend]()
+        with serve_background(service) as url:
+            host = url.split("//")[1]
+            setup_conn = http.client.HTTPConnection(host, timeout=60)
+            _replay_setup(setup_conn, prefix)
+            setup_conn.close()
+
+            # fresh unique participation ids, pre-serialized so body
+            # construction never rides the timed loop
+            bodies = []
+            for i in range(args.n):
+                p = dict(template)
+                p["id"] = f"22222222-{i >> 48 & 0xFFFF:04x}-4000-8000-{i & 0xFFFFFFFFFFFF:012d}"
+                bodies.append(json.dumps(p, separators=(",", ":")))
+            body_bytes = len(bodies[0])
+
+            results: list = [None] * args.threads
+            workers = []
+            per = -(-args.n // args.threads)
+            t0 = time.perf_counter()
+            for ix in range(args.threads):
+                chunk = bodies[ix * per: (ix + 1) * per]
+                w = threading.Thread(
+                    target=_post_slice,
+                    args=(host, part_step, chunk, results, ix),
+                )
+                w.start()
+                workers.append(w)
+            for w in workers:
+                w.join()
+            wall = time.perf_counter() - t0
+
+            posted = sum(r["ok"] for r in results if r)
+            if posted != args.n:
+                print(f"FAILED: {posted}/{args.n} accepted", file=sys.stderr)
+                return 1
+            # the store must actually HOLD the rows (status codes alone
+            # would bless a handler that acks and drops)
+            stored = service.server.aggregation_store.count_participations(
+                agg_id
+            )
+            if stored != args.n:
+                print(f"FAILED: {stored}/{args.n} rows stored",
+                      file=sys.stderr)
+                return 1
+            # sqlite: the db file plus -wal/-shm siblings; file backend: a
+            # directory tree; mem: nothing on disk
+            db_bytes = sum(
+                f.stat().st_size
+                for pat in ("db*", "files/**/*")
+                for f in Path(tmp).glob(pat)
+                if f.is_file()
+            ) or None
+
+    artifact = {
+        "metric": "rest_ingest_participations_per_second",
+        "backend": args.backend,
+        "n": args.n,
+        "threads": args.threads,
+        "wall_s": round(wall, 2),
+        "participations_per_s": round(args.n / wall, 1),
+        "body_bytes": body_bytes,
+        "stored_rows_verified": True,
+        "per_worker": [
+            {"ok": r["ok"], "wall_s": round(r["wall_s"], 2)} for r in results
+        ],
+        "store_bytes_after": db_bytes,
+    }
+    payload = json.dumps(artifact)
+    print(payload)
+    if args.out:
+        Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.out).write_text(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,28 @@ def modmatmul_np(A: np.ndarray, B: np.ndarray, m: int) -> np.ndarray:
         return np.vectorize(lambda v: rust_rem_int(int(v), m), otypes=[np.int64])(out)
     A = np.asarray(A, dtype=np.int64)
     B = np.asarray(B, dtype=np.int64)
+    # the K-sum of raw products is bounded by K*max|A|*max|B|, so when
+    # that fits the arithmetic the per-product reduction (two fmod
+    # passes over a (..., K, N) intermediate — the host protocol plane's
+    # hottest numpy work, ~70% of participate wall at dim 10K) collapses
+    # to one matmul + one rem. The bound uses the ACTUAL operand
+    # magnitudes (an O(size) amax, negligible vs the matmul), so
+    # unreduced inputs degrade to the robust per-product path instead of
+    # silently rounding. Representatives are unchanged for the canonical
+    # nonneg inputs the protocol plane feeds (raw sum and reduced-
+    # product sum are both nonneg), and stay within (-m, m) either way.
+    bound = (
+        A.shape[-1]
+        * max(1, int(np.abs(A).max(initial=0)))
+        * max(1, int(np.abs(B).max(initial=0)))
+    )
+    if bound < (1 << 53):
+        # every partial sum < 2^53: float64 is exact and the matmul runs
+        # on BLAS dgemm instead of numpy's generic int64 loop
+        prod = (A.astype(np.float64) @ B.astype(np.float64)).astype(np.int64)
+        return rust_rem_np(prod, m)
+    if bound < (1 << 63):
+        return rust_rem_np(A @ B, m)
     prods = rust_rem_np(A[..., :, None] * B[None, ...], m)  # (..., K, N)
     return rust_rem_np(prods.sum(axis=-2), m)
 
