@@ -11,3 +11,8 @@ SDA_TEST_HTTP=1 python -m pytest $BINDING_SENSITIVE -q
 SDA_TEST_HTTP=1 SDA_TEST_STORE=sqlite python -m pytest tests/test_full_loop.py tests/test_models_federated.py -q
 # BASELINE.md config ladder at 1/100 scale — wall-clocks + verification flags
 python scripts/baseline_ladder.py --quick --out "${MATRIX_LADDER_OUT:-/tmp/ladder-matrix-quick.json}"
+# device-mode ladder (fabric engines for configs 2-4) on the CPU backend:
+# the on-chip path only runs in rare healthy windows, so CI must keep it
+# from rotting — JAX_PLATFORMS=cpu makes "ambient backend" mean CPU here
+JAX_PLATFORMS=cpu python scripts/baseline_ladder.py --device --quick \
+    --out "${MATRIX_LADDER_DEVICE_OUT:-/tmp/ladder-matrix-device-quick.json}"

@@ -72,6 +72,9 @@ def test_bench_cpu_smoke_all_engines():
         # the rbg generator variant tpu-revalidate.sh banks each window
         # must stay runnable end-to-end, not just flag-parse
         ["--wide", "--rng", "rbg"],
+        # the roofline decomposition the revalidate north-star passes:
+        # two extra variant compiles, stage fractions, binding stage
+        ["--wide", "--roofline"],
     ):
         out = subprocess.run(
             [
@@ -105,6 +108,19 @@ def test_bench_cpu_smoke_all_engines():
                 assert line["check_cols"] == 1050 < line["dim"]
         if "--rng" in extra:
             assert line["rng"] == extra[extra.index("--rng") + 1]
+        # modeled roofline fields ride every metric line
+        roof = line["roofline"]
+        assert roof["hbm_gbps_model"] > 0 and "hbm_pct_v5e" in roof
+        if "--engine" in extra:
+            assert roof["int8_tops"] > 0  # participant engine: MXU work modeled
+        if "--roofline" in extra:
+            decomp = roof["decomposition"]
+            assert decomp["binding_stage"] in ("check", "rng_expand", "limb_reduce")
+            # at this test's microsecond segment times the stage fractions
+            # are noise-dominated, so only shape is pinned, not values
+            for f in ("frac_check", "frac_rng_expand", "frac_limb_reduce"):
+                assert decomp[f] >= 0.0, decomp
+            assert decomp["seg_nocheck_s"] >= 0 and decomp["seg_fill_s"] >= 0
 
 
 def test_bench_verification_catches_injected_fault():
