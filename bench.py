@@ -627,10 +627,11 @@ def parse_args() -> argparse.Namespace:
         action="store_true",
         help="after the measured run, time two extra compiled variants "
         "of the same segment (independent check removed; RNG replaced by "
-        "an iota fill) to attribute the steady rate to rng/reduce/check "
-        "stages and name the binding one; ~2 extra compiles of device "
-        "time (sumfirst engine only). Modeled HBM/MXU roofline fields "
-        "are emitted on every run regardless",
+        "an iota fill) to attribute the steady rate to check / "
+        "rng_expand / limb_reduce (sumfirst) or share_combine "
+        "(participant) and name the binding stage; ~2 extra compiles of "
+        "device time. Modeled HBM/MXU roofline fields are emitted on "
+        "every run regardless",
     )
     args = parser.parse_args()
     if args.probe is None:
@@ -647,8 +648,6 @@ def parse_args() -> argparse.Namespace:
         parser.error("--quick and --northstar are mutually exclusive")
     if args.check != "full" and args.engine != "sumfirst":
         parser.error("--check probe/off applies to the sumfirst engine")
-    if args.roofline and args.engine != "sumfirst":
-        parser.error("--roofline decomposition applies to the sumfirst engine")
     # presets fill only what the user left unset — explicit flags win.
     # Default = the driver's north-star config 5 itself: measuring the
     # headline metric at its true shape, not a proxy. The per-participant
@@ -729,6 +728,18 @@ def run(args: argparse.Namespace, watchdog) -> int:
             jnp.int64(p),
         )
 
+    def iota_fill_bits(shape, bits, out_dtype):
+        """Deterministic row+lane-varying mix for --roofline fill
+        variants, shared by both engines: generation is ~free (two iotas
+        + one mul-add) and XLA cannot strength-reduce its reduction, so
+        a fill-variant segment isolates everything BUT the RNG. A change
+        here changes the rng_expand attribution of both engines at once
+        — that coupling is the point."""
+        r = lax.broadcasted_iota(jnp.uint32, shape, 0)
+        c = lax.broadcasted_iota(jnp.uint32, shape, len(shape) - 1)
+        u = (r * jnp.uint32(2654435761) + c) & jnp.uint32((1 << min(bits, 31)) - 1)
+        return u.astype(out_dtype)
+
     if args.engine == "sumfirst":
         from sda_tpu.ops.rng import (
             uniform_bits_device,
@@ -807,19 +818,14 @@ def run(args: argparse.Namespace, watchdog) -> int:
                 return x[:, ::stride]
 
             def fill_pair(key, shape):
-                r = lax.broadcasted_iota(jnp.uint32, shape, 0)
-                c = lax.broadcasted_iota(jnp.uint32, shape, len(shape) - 1)
-                lo = r * jnp.uint32(2654435761) + c  # Knuth-mix: varies per row AND lane
+                # pair twin of iota_fill_bits: lo keeps the full 32-bit
+                # mix, hi re-masks it to the top field bits
+                lo = iota_fill_bits(shape, 32, jnp.uint32)
                 hi = lo & jnp.uint32((1 << max(1, nbits - 32)) - 1)
                 return hi, lo
 
             def fill_bits(key, shape, bits):
-                r = lax.broadcasted_iota(jnp.uint32, shape, 0)
-                c = lax.broadcasted_iota(jnp.uint32, shape, len(shape) - 1)
-                u = (r * jnp.uint32(2654435761) + c) & jnp.uint32(
-                    (1 << min(bits, 31)) - 1
-                )
-                return u.astype(jnp.int32 if narrow else jnp.int64)
+                return iota_fill_bits(shape, bits, jnp.int32 if narrow else jnp.int64)
 
             if pair:
                 gen = fill_pair if fill else pair_draw
@@ -920,27 +926,59 @@ def run(args: argparse.Namespace, watchdog) -> int:
         def mask_draw(key, shape, m):
             return draw_bits(key, shape, m.bit_length() - 1)
 
-        def body(carry, i):
-            acc, plain, key = carry
-            key, sk, rk = jax.random.split(key, 3)
-            secrets = draw_bits(sk, (chunk, dim), nbits)
-            if use_limbs:
-                # fused limb path: no 64-bit mul/div on the big tensors
-                if args.pallas:
-                    from sda_tpu.parallel.limb_pallas import share_combine_limb_pallas
+        def make_body(check, fill=False):
+            """Scan body for one (check-mode, generator) variant — the
+            participant-engine twin of the sumfirst factory above, so
+            --roofline can attribute this engine's steady rate too:
+            check='off' drops the independent plain sum, fill=True
+            replaces the draws with a row+lane-varying iota mix (XLA
+            cannot strength-reduce it), leaving the share matmul + clerk
+            reduction as the remainder."""
 
-                    chunk_acc = share_combine_limb_pallas(
-                        secrets, rk, plan, draw=mask_draw
-                    )
-                else:
-                    chunk_acc = share_combine_limb(secrets, rk, plan, draw=mask_draw)
-                acc = lax.rem(acc + chunk_acc, jnp.int64(p))
+            def fill_bits(key, shape, bits):
+                return iota_fill_bits(shape, bits, jnp.int32 if narrow else jnp.int64)
+
+            gen_bits = fill_bits if fill else draw_bits
+            if fill:
+                def gen_mask(key, shape, m):
+                    return fill_bits(key, shape, m.bit_length() - 1)
             else:
-                shares = share_participants(secrets, rk, plan, False, draw=mask_draw)
-                acc = lax.rem(
-                    acc + lax.rem(clerk_combine(shares), jnp.int64(p)), jnp.int64(p)
-                )
-            return (acc, plain_step(plain, secrets), key), ()
+                gen_mask = mask_draw
+
+            def body(carry, i):
+                acc, plain, key = carry
+                key, sk, rk = jax.random.split(key, 3)
+                secrets = gen_bits(sk, (chunk, dim), nbits)
+                if use_limbs:
+                    # fused limb path: no 64-bit mul/div on the big tensors
+                    if args.pallas:
+                        from sda_tpu.parallel.limb_pallas import (
+                            share_combine_limb_pallas,
+                        )
+
+                        chunk_acc = share_combine_limb_pallas(
+                            secrets, rk, plan, draw=gen_mask
+                        )
+                    else:
+                        chunk_acc = share_combine_limb(
+                            secrets, rk, plan, draw=gen_mask
+                        )
+                    acc = lax.rem(acc + chunk_acc, jnp.int64(p))
+                else:
+                    shares = share_participants(
+                        secrets, rk, plan, False, draw=gen_mask
+                    )
+                    acc = lax.rem(
+                        acc + lax.rem(clerk_combine(shares), jnp.int64(p)),
+                        jnp.int64(p),
+                    )
+                if check == "off":
+                    return (acc, plain, key), ()
+                return (acc, plain_step(plain, secrets), key), ()
+
+            return body
+
+        body = make_body("full")
 
         def finalize(acc, plain):
             if use_limbs:
@@ -1188,10 +1226,15 @@ def run(args: argparse.Namespace, watchdog) -> int:
 
                     t_nc = time_variant(make_body("off"))
                     t_fl = time_variant(make_body("off", fill=True))
+                    stage3 = (
+                        "limb_reduce"
+                        if args.engine == "sumfirst"
+                        else "share_combine"
+                    )
                     parts = {
                         "check": max(0.0, t_full - t_nc),
                         "rng_expand": max(0.0, t_nc - t_fl),
-                        "limb_reduce": t_fl,
+                        stage3: t_fl,
                     }
                     roofline["decomposition"] = {
                         "seg_full_s": round(t_full, 3),

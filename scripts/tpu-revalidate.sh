@@ -60,7 +60,11 @@ fi
 # the window for everything after it — but the FIRST artifact failing
 # fails the script so the loop doesn't charge its cooldown on nothing.
 echo "[revalidate] participant engine (per-participant MXU share matmuls)..." >&2
-python bench.py --engine participant --no-parity $SMOKE > "$out/participant-$stamp.json"
+# --roofline: the protocol-plane engine's first on-silicon artifact also
+# names its binding stage (check / rng_expand / share_combine); the
+# decomposition runs after the measured result with a bail timer, so a
+# wedge mid-decomposition still banks the headline value
+python bench.py --engine participant --roofline --no-parity $SMOKE > "$out/participant-$stamp.json"
 cat "$out/participant-$stamp.json"
 
 echo "[revalidate] participant engine, fused Pallas limb kernel..." >&2

@@ -73,8 +73,10 @@ def test_bench_cpu_smoke_all_engines():
         # must stay runnable end-to-end, not just flag-parse
         ["--wide", "--rng", "rbg"],
         # the roofline decomposition the revalidate north-star passes:
-        # two extra variant compiles, stage fractions, binding stage
+        # two extra variant compiles, stage fractions, binding stage —
+        # on both engines (participant names its stage share_combine)
         ["--wide", "--roofline"],
+        ["--engine", "participant", "--roofline"],
     ):
         out = subprocess.run(
             [
@@ -115,10 +117,11 @@ def test_bench_cpu_smoke_all_engines():
             assert roof["int8_tops"] > 0  # participant engine: MXU work modeled
         if "--roofline" in extra:
             decomp = roof["decomposition"]
-            assert decomp["binding_stage"] in ("check", "rng_expand", "limb_reduce")
+            stage3 = "share_combine" if "participant" in extra else "limb_reduce"
+            assert decomp["binding_stage"] in ("check", "rng_expand", stage3)
             # at this test's microsecond segment times the stage fractions
             # are noise-dominated, so only shape is pinned, not values
-            for f in ("frac_check", "frac_rng_expand", "frac_limb_reduce"):
+            for f in ("frac_check", "frac_rng_expand", f"frac_{stage3}"):
                 assert decomp[f] >= 0.0, decomp
             assert decomp["seg_nocheck_s"] >= 0 and decomp["seg_fill_s"] >= 0
 
