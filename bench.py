@@ -629,9 +629,9 @@ def parse_args() -> argparse.Namespace:
         "of the same segment (independent check removed; RNG replaced by "
         "an iota fill) to attribute the steady rate to check / "
         "rng_expand / limb_reduce (sumfirst) or share_combine "
-        "(participant) and name the binding stage; ~2 extra compiles of "
-        "device time. Modeled HBM/MXU roofline fields are emitted on "
-        "every run regardless",
+        "(participant) and name the binding stage; ~2 extra compiles "
+        "plus a few re-timed segments of device time. Modeled HBM/MXU "
+        "roofline fields are emitted on every run regardless",
     )
     args = parser.parse_args()
     if args.probe is None:
@@ -737,8 +737,26 @@ def run(args: argparse.Namespace, watchdog) -> int:
         — that coupling is the point."""
         r = lax.broadcasted_iota(jnp.uint32, shape, 0)
         c = lax.broadcasted_iota(jnp.uint32, shape, len(shape) - 1)
-        u = (r * jnp.uint32(2654435761) + c) & jnp.uint32((1 << min(bits, 31)) - 1)
+        # cap: int32 outputs must stay nonneg (bit 31 clear); uint32/int64
+        # outputs keep the full 32-bit mix
+        cap = 31 if out_dtype == jnp.int32 else 32
+        u = (r * jnp.uint32(2654435761) + c) & jnp.uint32((1 << min(bits, cap)) - 1)
         return u.astype(out_dtype)
+
+    def gen_selectors(draw_bits, mask_draw, narrow, fill):
+        """(gen_bits, gen_mask) for one body variant: the real draws, or
+        the iota fill in the same dtypes — ONE wiring for both engines so
+        their rng_expand attribution can't drift apart."""
+        if not fill:
+            return draw_bits, mask_draw
+
+        def fill_bits(key, shape, bits):
+            return iota_fill_bits(shape, bits, jnp.int32 if narrow else jnp.int64)
+
+        def fill_mask(key, shape, m):
+            return fill_bits(key, shape, m.bit_length() - 1)
+
+        return fill_bits, fill_mask
 
     if args.engine == "sumfirst":
         from sda_tpu.ops.rng import (
@@ -824,8 +842,7 @@ def run(args: argparse.Namespace, watchdog) -> int:
                 hi = lo & jnp.uint32((1 << max(1, nbits - 32)) - 1)
                 return hi, lo
 
-            def fill_bits(key, shape, bits):
-                return iota_fill_bits(shape, bits, jnp.int32 if narrow else jnp.int64)
+            gen_bits, gen_mask = gen_selectors(draw_bits, mask_draw, narrow, fill)
 
             if pair:
                 gen = fill_pair if fill else pair_draw
@@ -847,13 +864,6 @@ def run(args: argparse.Namespace, watchdog) -> int:
                     return (acc, plain + csum, key), ()
 
                 return body
-
-            gen_bits = fill_bits if fill else draw_bits
-            if fill:
-                def gen_mask(key, shape, m):
-                    return fill_bits(key, shape, m.bit_length() - 1)
-            else:
-                gen_mask = mask_draw
 
             def body(carry, i):
                 acc, plain, key = carry
@@ -935,15 +945,7 @@ def run(args: argparse.Namespace, watchdog) -> int:
             cannot strength-reduce it), leaving the share matmul + clerk
             reduction as the remainder."""
 
-            def fill_bits(key, shape, bits):
-                return iota_fill_bits(shape, bits, jnp.int32 if narrow else jnp.int64)
-
-            gen_bits = fill_bits if fill else draw_bits
-            if fill:
-                def gen_mask(key, shape, m):
-                    return fill_bits(key, shape, m.bit_length() - 1)
-            else:
-                gen_mask = mask_draw
+            gen_bits, gen_mask = gen_selectors(draw_bits, mask_draw, narrow, fill)
 
             def body(carry, i):
                 acc, plain, key = carry
@@ -1202,21 +1204,15 @@ def run(args: argparse.Namespace, watchdog) -> int:
             bail_timer.start()
             with stage("roofline decomposition (2 variant compiles)"):
                 try:
-                    t_full = steady_s / (done_segments - 1)
-
-                    def time_variant(body_fn):
-                        seg = jax.jit(
-                            lambda a, pl, kk: lax.scan(
-                                body_fn, (a, pl, kk), jnp.arange(seg_chunks)
-                            )[0]
-                        )
+                    def time_seg(seg, plain_len=1, warm=True):
                         a = jnp.zeros(acc_shape, dtype=jnp.int64)
-                        pl = jnp.zeros((1,), dtype=jnp.int64)
+                        pl = jnp.zeros((plain_len,), dtype=jnp.int64)
                         kk = jax.random.key(
                             43, impl=None if args.rng == "threefry" else args.rng
                         )
-                        a, pl, kk = seg(a, pl, kk)  # compile + warm
-                        np.asarray(pl)
+                        if warm:  # variants: compile + warm; run_seg is
+                            a, pl, kk = seg(a, pl, kk)  # already both
+                            np.asarray(pl)
                         reps = 2
                         t0 = time.perf_counter()
                         for _ in range(reps):
@@ -1224,8 +1220,22 @@ def run(args: argparse.Namespace, watchdog) -> int:
                             np.asarray(pl)
                         return (time.perf_counter() - t0) / reps
 
-                    t_nc = time_variant(make_body("off"))
-                    t_fl = time_variant(make_body("off", fill=True))
+                    def variant_seg(body_fn):
+                        return jax.jit(
+                            lambda a, pl, kk: lax.scan(
+                                body_fn, (a, pl, kk), jnp.arange(seg_chunks)
+                            )[0]
+                        )
+
+                    # all three points timed the same way back-to-back
+                    # (same reps, fresh carries, same chip state) so the
+                    # stage fractions compare like with like; the full
+                    # point reuses run_seg's existing compile. The
+                    # steady-run segment time rides in seg_steady_s for
+                    # cross-reference but does not enter the fractions.
+                    t_full = time_seg(run_seg, max(1, n_check), warm=False)
+                    t_nc = time_seg(variant_seg(make_body("off")))
+                    t_fl = time_seg(variant_seg(make_body("off", fill=True)))
                     stage3 = (
                         "limb_reduce"
                         if args.engine == "sumfirst"
@@ -1238,6 +1248,9 @@ def run(args: argparse.Namespace, watchdog) -> int:
                     }
                     roofline["decomposition"] = {
                         "seg_full_s": round(t_full, 3),
+                        "seg_steady_s": round(
+                            steady_s / (done_segments - 1), 3
+                        ),
                         "seg_nocheck_s": round(t_nc, 3),
                         "seg_fill_s": round(t_fl, 3),
                         **{
