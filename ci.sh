@@ -34,6 +34,7 @@ sh scripts/simple-cli-example.sh
 echo "=== ci 3/3: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null
 python examples/federated_analytics.py >/dev/null
+python examples/secure_sum_fabric.py >/dev/null
 # three seeded rounds of the randomized two-process crash soak: cheap
 # (~30 s) insurance that the deployment survives hard process death;
 # a failure here is a real resilience bug, not flake (seeds printed)
