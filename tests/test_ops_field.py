@@ -400,3 +400,38 @@ def test_chacha_expand_rejects_oversized_modulus():
     with pytest.raises(ValueError, match="int64"):
         chacha.rand03_zone((1 << 63) + 1)
     assert chacha.rand03_zone(1 << 63) == 1 << 63  # boundary is legal
+
+
+def test_uniform_mod_host_drbg_path(monkeypatch):
+    """Large default-entropy draws route through the native ChaCha DRBG
+    (fresh full 256-bit key per call); contract pinned: int64, unbiased
+    range, distinct across calls, the gate ACTUALLY takes the DRBG path
+    (recorded via monkeypatch, so a gate regression cannot pass silently
+    through the urandom fallback), and a custom entropy source always
+    takes the deterministic direct path regardless of size."""
+    from sda_tpu import native
+
+    for m in (433, 1 << 32, (1 << 61) - 1):
+        a = uniform_mod_host((4096,), m)
+        b = uniform_mod_host((4096,), m)
+        assert a.dtype == np.int64 and a.min() >= 0 and a.max() < m
+        assert not np.array_equal(a, b)  # fresh seed per call
+    if native.available():
+        calls = []
+        real = native.chacha_expand
+
+        def recording(seed, dim, modulus):
+            calls.append((np.asarray(seed).size, dim))
+            return real(seed, dim, modulus)
+
+        monkeypatch.setattr(native, "chacha_expand", recording)
+        draws = uniform_mod_host((10000,), 433)
+        # the gate took the DRBG path, with the full 8-word (256-bit) key
+        assert calls == [(8, 10000)], calls
+        # residue coverage on the DRBG path (mirrors the urandom test)
+        assert len(np.unique(draws)) == 433
+        calls.clear()
+        uniform_mod_host((8,), 433)  # small: direct path
+        assert calls == []
+    det = uniform_mod_host((4096,), 433, entropy=lambda k: b"\x2a" * k)
+    assert (det == det[0]).all()  # custom entropy: direct path, no seed mix
