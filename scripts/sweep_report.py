@@ -29,8 +29,10 @@ def load(artdir: pathlib.Path):
         rows.append(
             {
                 "artifact": f.name,
-                "rng": d.get("rng", "threefry"),
-                "check": d.get("check", "full"),
+                # None = not recorded (pre-r5): tag_of falls back to the
+                # filename tag instead of assuming the defaults
+                "rng": d.get("rng"),
+                "check": d.get("check"),
                 "chunk": d.get("chunk"),
                 "value": d["value"],
                 "steady_s": d.get("steady_s"),
@@ -43,17 +45,27 @@ def load(artdir: pathlib.Path):
 
 
 def tag_of(row):
-    # chunk: prefer the metric line (bench.py records it since r5 — the
-    # check-variant artifacts then group under their real default chunk
-    # instead of chunk=None, ADVICE r4 #2); filename tag as fallback for
-    # pre-r5 artifacts (exp-<rng>-c<chunk>-<stamp>.json)
-    chunk = row.get("chunk")
-    if chunk is None:
-        parts = row["artifact"].split("-")
-        chunk = next(
-            (p[1:] for p in parts if p.startswith("c") and p[1:].isdigit()), None
-        )
-    return row["rng"], str(chunk) if chunk is not None else None, row["check"]
+    # prefer the metric line (bench.py records rng/chunk/check since r5,
+    # ADVICE r4 #2); filename tag as fallback for pre-r5 artifacts
+    # (exp-<rng>-c<chunk>-<stamp>.json / exp-<rng>-<check>-<stamp>.json).
+    # The old fallback recovered only the c<chunk> part, so pre-r5
+    # check-variant artifacts (exp-rbg-probe-*, exp-threefry-off-*) fell
+    # through to check="full" and collapsed into the full-check group —
+    # mislabeled, and eligible to win the full-check recommendation with
+    # a rate the full check never produced.
+    rng, chunk, check = row.get("rng"), row.get("chunk"), row.get("check")
+    for p in row["artifact"].rsplit(".", 1)[0].split("-")[1:]:
+        if chunk is None and p.startswith("c") and p[1:].isdigit():
+            chunk = p[1:]
+        elif check is None and p in ("probe", "off"):
+            check = p
+        elif rng is None and p in ("threefry", "rbg"):
+            rng = p
+    return (
+        rng or "threefry",
+        str(chunk) if chunk is not None else None,
+        check or "full",
+    )
 
 
 def main() -> int:
@@ -71,7 +83,7 @@ def main() -> int:
 
     print(f"{'rng':>9} {'chunk':>6} {'check':>6} {'elems/s':>12} "
           f"{'steady_s':>9} {'partial':>7}  artifact")
-    for key in sorted(best):
+    for key in sorted(best, key=lambda k: tuple(x or "" for x in k)):
         r = best[key]
         rng, chunk, check = key
         print(

@@ -6,6 +6,12 @@ recipient), share the masked vector across the committee, then per clerk
 fetch + signature-verify the encryption key and seal that clerk's share
 vector. ``new_participation`` is separate from upload so retries are
 idempotent under the client-chosen ParticipationId.
+
+``new_participations``/``participate_many`` are the batched forms: the
+aggregation, committee, and verified clerk keys are fetched once, every
+clerk share across the whole batch is sealed in one engine call
+(crypto.encrypt_share_matrix), and upload goes through the service's bulk
+``create_participations`` — the client half of the batched ingest pipeline.
 """
 
 from __future__ import annotations
@@ -21,17 +27,64 @@ class Participating(VerifiedKeys):
         participation = self.new_participation(values, aggregation_id)
         self.upload_participation(participation)
 
+    def participate_many(self, values_list, aggregation_id, chunk_size: int = 256) -> list:
+        """Build + upload one participation per entry of ``values_list``,
+        batching both the crypto and the submission. Returns the ids.
+
+        Chunks of ``chunk_size`` are PIPELINED: while chunk k uploads on a
+        worker thread (one keep-alive POST on the batch route), the main
+        thread is already sealing chunk k+1 — build and network never
+        serialize. Each chunk is one atomic submit; a failed chunk raises
+        before any later chunk is submitted (earlier chunks stay stored,
+        and are idempotently replayable)."""
+        import threading
+
+        values_list = list(values_list)
+        ids: list = []
+        errors: list = []
+
+        def submit(batch):
+            try:
+                self.upload_participations(batch)
+            except BaseException as e:
+                errors.append(e)
+
+        inflight = None
+        for lo in range(0, len(values_list), chunk_size):
+            batch = self.new_participations(
+                values_list[lo : lo + chunk_size], aggregation_id
+            )
+            if inflight is not None:
+                inflight.join()
+                if errors:
+                    raise errors[0]
+            ids.extend(p.id for p in batch)
+            inflight = threading.Thread(target=submit, args=(batch,))
+            inflight.start()
+        if inflight is not None:
+            inflight.join()
+            if errors:
+                raise errors[0]
+        return ids
+
     def upload_participation(self, participation) -> None:
         self.service.create_participation(self.agent, participation)
 
+    def upload_participations(self, participations) -> None:
+        self.service.create_participations(self.agent, list(participations))
+
     def new_participation(self, values, aggregation_id) -> Participation:
-        secrets = np.asarray(values, dtype=np.int64)
+        return self.new_participations([values], aggregation_id)[0]
+
+    def new_participations(self, values_list, aggregation_id) -> list:
+        secrets_rows = [np.asarray(v, dtype=np.int64) for v in values_list]
 
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise ValueError("Could not find aggregation")
-        if len(secrets) != aggregation.vector_dimension:
-            raise ValueError("The input length does not match the aggregation.")
+        for secrets in secrets_rows:
+            if len(secrets) != aggregation.vector_dimension:
+                raise ValueError("The input length does not match the aggregation.")
 
         committee = self.service.get_committee(self.agent, aggregation_id)
         if committee is None:
@@ -39,36 +92,45 @@ class Participating(VerifiedKeys):
 
         # mask the secrets
         masker = self.crypto.new_secret_masker(aggregation.masking_scheme)
-        recipient_mask, masked_secrets = masker.mask(secrets)
+        masked = [masker.mask(secrets) for secrets in secrets_rows]
 
-        recipient_encryption = None
-        if len(recipient_mask) > 0:
+        # recipient mask encryptions (absent under NoMasking)
+        recipient_encryptions = [None] * len(masked)
+        mask_rows = [m for m, _ in masked]
+        if mask_rows and len(mask_rows[0]) > 0:
             recipient_key = self._fetch_verified_key(
                 aggregation.recipient, aggregation.recipient_key
             )
             mask_encryptor = self.crypto.new_share_encryptor(
                 recipient_key, aggregation.recipient_encryption_scheme
             )
-            recipient_encryption = mask_encryptor.encrypt(recipient_mask)
+            if hasattr(mask_encryptor, "encrypt_batch"):
+                recipient_encryptions = mask_encryptor.encrypt_batch(mask_rows)
+            else:
+                recipient_encryptions = [mask_encryptor.encrypt(m) for m in mask_rows]
 
-        # share the masked secrets: one share vector per clerk
+        # share the masked secrets: one share vector per clerk, for every
+        # participation in the batch, then seal the whole P x C matrix in
+        # one engine call
         generator = self.crypto.new_share_generator(aggregation.committee_sharing_scheme)
-        shares_per_clerk = generator.generate(masked_secrets)  # (n_clerks, len)
+        share_rows = [generator.generate(masked_secrets) for _, masked_secrets in masked]
 
-        clerk_encryptions = []
-        for clerk_index, (clerk_id, clerk_key_id) in enumerate(committee.clerks_and_keys):
-            clerk_key = self._fetch_verified_key(clerk_id, clerk_key_id)
-            share_encryptor = self.crypto.new_share_encryptor(
-                clerk_key, aggregation.committee_encryption_scheme
-            )
-            clerk_encryptions.append(
-                (clerk_id, share_encryptor.encrypt(shares_per_clerk[clerk_index]))
-            )
-
-        return Participation(
-            id=ParticipationId.random(),
-            participant=self.agent.id,
-            aggregation=aggregation.id,
-            recipient_encryption=recipient_encryption,
-            clerk_encryptions=clerk_encryptions,
+        clerk_ids = [clerk_id for clerk_id, _ in committee.clerks_and_keys]
+        clerk_keys = [
+            self._fetch_verified_key(clerk_id, clerk_key_id)
+            for clerk_id, clerk_key_id in committee.clerks_and_keys
+        ]
+        encryption_rows = self.crypto.encrypt_share_matrix(
+            clerk_keys, aggregation.committee_encryption_scheme, share_rows
         )
+
+        return [
+            Participation(
+                id=ParticipationId.random(),
+                participant=self.agent.id,
+                aggregation=aggregation.id,
+                recipient_encryption=recipient_encryptions[i],
+                clerk_encryptions=list(zip(clerk_ids, encryption_rows[i])),
+            )
+            for i in range(len(secrets_rows))
+        ]

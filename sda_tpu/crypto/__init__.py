@@ -78,6 +78,10 @@ class CryptoModule:
     def new_share_encryptor(self, ek, scheme):
         return encryption.new_share_encryptor(ek, scheme)
 
+    def encrypt_share_matrix(self, clerk_keys, scheme, share_rows):
+        """Committee-wide batch sealing; see encryption.encrypt_share_matrix."""
+        return encryption.encrypt_share_matrix(clerk_keys, scheme, share_rows)
+
     def new_share_decryptor(self, key_id: EncryptionKeyId, scheme):
         pair = self.keystore.get_encryption_keypair(key_id)
         if pair is None:

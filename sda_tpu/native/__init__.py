@@ -46,7 +46,7 @@ def varint_decode(buf: bytes) -> np.ndarray:
 
 def _default_threads() -> int:
     """Sealed-box worker threads: ``SDA_NATIVE_THREADS`` if set, else one
-    per CPU. The C plane strides the batch across a pthread pool with the
+    per CPU. The C plane chunks the batch across a pthread pool with the
     GIL released — results are independent of the thread count (each item
     is sealed/opened by exactly one thread)."""
     import os
@@ -77,6 +77,31 @@ def open_batch(
     from ..crypto import sodium
 
     return [sodium.seal_open(c, public_key, secret_key) for c in ciphertexts]
+
+
+def seal_participations(
+    share_matrix: list, public_keys: list, n_threads: int | None = None
+) -> list:
+    """Seal a ``P x C`` matrix of share messages to ``C`` clerk public keys:
+    ``result[p][c]`` is ``share_matrix[p][c]`` sealed to ``public_keys[c]``.
+
+    The C plane shares one ephemeral keypair per participant across that
+    participant's ``C`` sealed boxes and amortizes the X25519 scalarmults
+    with per-clerk comb tables, so large batches seal at ~(1 + 1/C)
+    comb-multiplications per share instead of two Montgomery ladders.
+    Every output stays a standard ``crypto_box_seal`` sealed box."""
+    if _ext is not None:
+        return _ext.seal_participations(
+            [list(row) for row in share_matrix],
+            list(public_keys),
+            n_threads or _default_threads(),
+        )
+    from ..crypto import sodium
+
+    return [
+        [sodium.seal(m, pk) for m, pk in zip(row, public_keys)]
+        for row in share_matrix
+    ]
 
 
 def _chacha_keys(seed_rows: np.ndarray) -> bytes:

@@ -24,16 +24,33 @@
 #define crypto_box_PUBLICKEYBYTES 32U
 #define crypto_box_SECRETKEYBYTES 32U
 #define crypto_box_SEALBYTES 48U /* PUBLICKEYBYTES + MACBYTES */
+#define crypto_box_MACBYTES 16U
+#define crypto_box_NONCEBYTES 24U
 extern int sodium_init(void);
+extern void sodium_memzero(void *pnt, size_t len);
+extern void randombytes_buf(void *buf, size_t size);
 extern int crypto_box_seal(unsigned char *c, const unsigned char *m,
                            unsigned long long mlen, const unsigned char *pk);
 extern int crypto_box_seal_open(unsigned char *m, const unsigned char *c,
                                 unsigned long long clen, const unsigned char *pk,
                                 const unsigned char *sk);
+extern int crypto_core_hsalsa20(unsigned char *out, const unsigned char *in,
+                                const unsigned char *k, const unsigned char *c);
+extern int crypto_generichash(unsigned char *out, size_t outlen,
+                              const unsigned char *in, unsigned long long inlen,
+                              const unsigned char *key, size_t keylen);
+extern int crypto_box_easy_afternm(unsigned char *c, const unsigned char *m,
+                                   unsigned long long mlen, const unsigned char *n,
+                                   const unsigned char *k);
 extern int crypto_stream_chacha20_xor_ic(unsigned char *c, const unsigned char *m,
                                          unsigned long long mlen,
                                          const unsigned char *n, uint64_t ic,
                                          const unsigned char *k);
+
+/* Amalgamated (single translation unit) so the field ops inline into the
+ * batch loops below.  Provides comb_table, sda_comb_table_base,
+ * sda_comb_table_from_u, sda_comb_scalarmult_frac, sda_comb_finalize_u. */
+#include "curve25519_comb.c"
 
 /* ---------------- varint ---------------- */
 
@@ -119,28 +136,110 @@ static PyObject *varint_decode(PyObject *self, PyObject *args) {
 
 /* ---------------- sealed boxes ----------------
  *
- * Both batch entry points take an optional trailing ``n_threads`` (default
- * 1). The GIL is released for the whole batch either way; with n_threads
- * > 1 the batch is strided across a pthread pool — each item's
- * input/output buffer is touched by exactly one thread, and every Python
- * object is created before the pool starts, so no Python API runs
- * off-thread. libsodium seal/open are thread-safe (stateless; the
- * ephemeral keypair inside crypto_box_seal draws from thread-safe
- * randombytes). Failures record the lowest failing index so the raised
- * error is deterministic regardless of thread interleaving. */
+ * Batch entry points take an optional trailing ``n_threads`` (default 1).
+ * The GIL is released once for the whole batch; with n_threads > 1 each
+ * worker owns one CONTIGUOUS chunk of the batch (not a stride), so a
+ * worker's reads/writes stay in one cache-warm region and the per-chunk
+ * comb state (scalar fractions awaiting batch inversion) needs no
+ * cross-thread coordination.  Every Python object is created before the
+ * pool starts, so no Python API runs off-thread.  libsodium primitives
+ * used here are thread-safe.  Failures record the lowest failing index so
+ * the raised error is deterministic regardless of thread interleaving.
+ *
+ * Sealing to one recipient amortizes the expensive X25519 work with comb
+ * tables (see curve25519_comb.c): the base-point table is built once per
+ * process, the recipient table once per batch, and each seal then costs
+ * 64+64 mixed Edwards additions instead of two Montgomery ladders, with
+ * the per-item field inversions folded into one Montgomery batch
+ * inversion per chunk.  The output is composed with libsodium's own
+ * HSalsa20 + XSalsa20-Poly1305, so it remains a standard crypto_box_seal
+ * sealed box (epk || box), openable by any existing client.  Batches
+ * smaller than SDA_COMB_MIN_BATCH, and recipient keys that do not lift to
+ * a curve point, fall back to plain crypto_box_seal per item. */
+
+#define SDA_COMB_MIN_BATCH 8
+
+static comb_table g_base_table;           /* esk*G table, built once */
+static int g_base_table_ready = 0;        /* guarded by the GIL */
+
+static int is_zero32(const unsigned char *p) {
+    unsigned char acc = 0;
+    int i;
+    for (i = 0; i < 32; i++) acc |= p[i];
+    return acc == 0;
+}
+
+/* Seal ins[lo..hi) to pk using comb tables; one ephemeral key per item.
+ * Returns -1 on success or the lowest failing index. */
+static Py_ssize_t comb_seal_range(const comb_table *pt, const unsigned char *pk,
+                                  const unsigned char **ins,
+                                  const Py_ssize_t *inlens, unsigned char **outs,
+                                  Py_ssize_t lo, Py_ssize_t hi) {
+    Py_ssize_t n = hi - lo, i;
+    fe *num, *den, *scr;
+    unsigned char *esks, *us;
+    if (n <= 0) return -1;
+    num = malloc(sizeof(fe) * (size_t)n * 2);
+    den = malloc(sizeof(fe) * (size_t)n * 2);
+    scr = malloc(sizeof(fe) * (size_t)n * 2);
+    esks = malloc((size_t)n * 32);
+    us = malloc((size_t)n * 64); /* per item: epk u (32) || shared u (32) */
+    if (!num || !den || !scr || !esks || !us) {
+        /* allocation pressure: do the slow, allocation-free thing */
+        free(num); free(den); free(scr); free(esks); free(us);
+        for (i = lo; i < hi; i++)
+            if (crypto_box_seal(outs[i], ins[i], (unsigned long long)inlens[i],
+                                pk) != 0)
+                return i;
+        return -1;
+    }
+    for (i = 0; i < n; i++) {
+        unsigned char *esk = esks + i * 32;
+        randombytes_buf(esk, 32);
+        esk[0] &= 248; esk[31] &= 127; esk[31] |= 64; /* X25519 clamp */
+        sda_comb_scalarmult_frac(&num[2 * i], &den[2 * i], &g_base_table, esk);
+        sda_comb_scalarmult_frac(&num[2 * i + 1], &den[2 * i + 1], pt, esk);
+    }
+    sda_comb_finalize_u(us, num, den, scr, (int)(n * 2));
+    for (i = 0; i < n; i++) {
+        const unsigned char *epk = us + i * 64;
+        const unsigned char *shared = us + i * 64 + 32;
+        unsigned char k[32], nonce[crypto_box_NONCEBYTES], hin[64];
+        static const unsigned char zero16[16] = {0};
+        if (is_zero32(shared)) break; /* mirrors crypto_box_beforenm failure */
+        crypto_core_hsalsa20(k, zero16, shared, NULL);
+        memcpy(hin, epk, 32);
+        memcpy(hin + 32, pk, 32);
+        crypto_generichash(nonce, sizeof nonce, hin, sizeof hin, NULL, 0);
+        memcpy(outs[lo + i], epk, 32);
+        crypto_box_easy_afternm(outs[lo + i] + 32, ins[lo + i],
+                                (unsigned long long)inlens[lo + i], nonce, k);
+        sodium_memzero(k, sizeof k);
+    }
+    sodium_memzero(esks, (size_t)n * 32);
+    sodium_memzero(us, (size_t)n * 64);
+    free(num); free(den); free(scr); free(esks); free(us);
+    return i < n ? lo + i : -1;
+}
 
 typedef struct {
-    Py_ssize_t n, start, step;
+    Py_ssize_t lo, hi;
     const unsigned char **ins;
     const Py_ssize_t *inlens;
     unsigned char **outs;
     const unsigned char *pk, *sk; /* sk NULL => seal, else open */
-    Py_ssize_t fail;              /* lowest failing index in stride, or -1 */
+    const comb_table *pt;         /* non-NULL => comb seal path */
+    Py_ssize_t fail;              /* lowest failing index in chunk, or -1 */
 } sealjob_t;
 
 static void *seal_open_worker(void *arg) {
     sealjob_t *j = (sealjob_t *)arg;
-    for (Py_ssize_t i = j->start; i < j->n; i += j->step) {
+    if (j->pt && !j->sk) {
+        j->fail = comb_seal_range(j->pt, j->pk, j->ins, j->inlens, j->outs,
+                                  j->lo, j->hi);
+        return NULL;
+    }
+    for (Py_ssize_t i = j->lo; i < j->hi; i++) {
         int rc;
         if (j->sk) {
             rc = crypto_box_seal_open(j->outs[i], j->ins[i],
@@ -152,8 +251,8 @@ static void *seal_open_worker(void *arg) {
         }
         if (rc != 0) {
             j->fail = i;
-            return NULL; /* first failure in stride wins; lowest across
-                          * strides picked at join */
+            return NULL; /* lowest index within the chunk; lowest across
+                          * chunks picked at join */
         }
     }
     return NULL;
@@ -206,23 +305,40 @@ static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
         inlens[i] = blen;
         outs[i] = (unsigned char *)PyBytes_AS_STRING(res);
     }
-    /* phase 2 (GIL released): the crypto */
+    /* phase 2 (GIL released): the crypto, chunked across the pool */
     if (n_threads < 1) n_threads = 1;
     if (n_threads > n) n_threads = n ? n : 1;
     if (n_threads > SEAL_MAX_THREADS) n_threads = SEAL_MAX_THREADS;
     {
         Py_ssize_t first_fail = -1;
+        comb_table *pt = NULL;
+        if (!sk && n >= SDA_COMB_MIN_BATCH) {
+            pt = PyMem_Malloc(sizeof(comb_table));
+            if (pt) {
+                if (!g_base_table_ready) { /* GIL still held here */
+                    sda_comb_table_base(&g_base_table);
+                    g_base_table_ready = 1;
+                }
+                if (sda_comb_table_from_u(pt, pk) != 0) {
+                    PyMem_Free(pt); /* pk does not lift: scalar fallback */
+                    pt = NULL;
+                }
+            }
+        }
         Py_BEGIN_ALLOW_THREADS
         if (n_threads <= 1) {
-            sealjob_t job = {n, 0, 1, ins, inlens, outs, pk, sk, -1};
+            sealjob_t job = {0, n, ins, inlens, outs, pk, sk, pt, -1};
             seal_open_worker(&job);
             first_fail = job.fail;
         } else {
             sealjob_t jobs[SEAL_MAX_THREADS];
             pthread_t tids[SEAL_MAX_THREADS];
             int started[SEAL_MAX_THREADS];
+            Py_ssize_t chunk = (n + n_threads - 1) / n_threads;
             for (long t = 0; t < n_threads; t++) {
-                sealjob_t j = {n, t, n_threads, ins, inlens, outs, pk, sk, -1};
+                Py_ssize_t lo = t * chunk;
+                Py_ssize_t hi = lo + chunk < n ? lo + chunk : n;
+                sealjob_t j = {lo, hi, ins, inlens, outs, pk, sk, pt, -1};
                 jobs[t] = j;
                 started[t] =
                     pthread_create(&tids[t], NULL, seal_open_worker, &jobs[t]) == 0;
@@ -236,6 +352,7 @@ static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
             }
         }
         Py_END_ALLOW_THREADS
+        PyMem_Free(pt);
         if (first_fail >= 0) {
             if (sk)
                 PyErr_Format(PyExc_ValueError, "sealed box %zd failed to open",
@@ -290,6 +407,230 @@ static PyObject *open_batch(PyObject *self, PyObject *args) {
     PyBuffer_Release(&pk);
     PyBuffer_Release(&sk);
     return out;
+}
+
+/* ---------------- committee sealing ----------------
+ *
+ * seal_participations(shares, pks, n_threads): P participants x C clerks.
+ * shares[p][c] is sealed to pks[c].  One ephemeral keypair per PARTICIPANT
+ * is shared across that participant's C sealed boxes (standard
+ * multi-recipient construction: nonce = blake2b(epk || pk_c) and key =
+ * HSalsa20(esk * pk_c) both differ per clerk, so no nonce/key reuse), which
+ * drops the per-share X25519 cost from two scalarmults to (1 + 1/C).  Each
+ * output is still a standard crypto_box_seal sealed box for its clerk.
+ * The C shares of one participation are already linked publicly by the
+ * participation record itself, so the shared epk leaks nothing new. */
+
+typedef struct {
+    Py_ssize_t plo, phi, C;
+    const unsigned char **ins; /* flat [p*C + c] */
+    const Py_ssize_t *inlens;
+    unsigned char **outs;
+    const unsigned char *pks;  /* C*32 contiguous */
+    const comb_table *pts;     /* C tables, or NULL => scalar path */
+    Py_ssize_t fail;
+} partjob_t;
+
+static void *participations_worker(void *arg) {
+    partjob_t *j = (partjob_t *)arg;
+    Py_ssize_t C = j->C, nP = j->phi - j->plo, p, c;
+    j->fail = -1;
+    if (nP <= 0 || C <= 0) return NULL;
+    if (j->pts) {
+        Py_ssize_t per = 1 + C, nf = nP * per;
+        fe *num = malloc(sizeof(fe) * (size_t)nf);
+        fe *den = malloc(sizeof(fe) * (size_t)nf);
+        fe *scr = malloc(sizeof(fe) * (size_t)nf);
+        unsigned char *esk = malloc((size_t)nP * 32);
+        unsigned char *us = malloc((size_t)nf * 32);
+        if (num && den && scr && esk && us) {
+            for (p = 0; p < nP; p++) {
+                unsigned char *e = esk + p * 32;
+                Py_ssize_t b = p * per;
+                randombytes_buf(e, 32);
+                e[0] &= 248; e[31] &= 127; e[31] |= 64;
+                sda_comb_scalarmult_frac(&num[b], &den[b], &g_base_table, e);
+                for (c = 0; c < C; c++)
+                    sda_comb_scalarmult_frac(&num[b + 1 + c], &den[b + 1 + c],
+                                             &j->pts[c], e);
+            }
+            sda_comb_finalize_u(us, num, den, scr, (int)nf);
+            for (p = 0; p < nP && j->fail < 0; p++) {
+                const unsigned char *epk = us + p * per * 32;
+                for (c = 0; c < C; c++) {
+                    const unsigned char *shared = us + (p * per + 1 + c) * 32;
+                    const unsigned char *pk = j->pks + c * 32;
+                    Py_ssize_t flat = (j->plo + p) * C + c;
+                    unsigned char k[32], nonce[crypto_box_NONCEBYTES], hin[64];
+                    static const unsigned char zero16[16] = {0};
+                    if (is_zero32(shared)) { j->fail = flat; break; }
+                    crypto_core_hsalsa20(k, zero16, shared, NULL);
+                    memcpy(hin, epk, 32);
+                    memcpy(hin + 32, pk, 32);
+                    crypto_generichash(nonce, sizeof nonce, hin, sizeof hin,
+                                       NULL, 0);
+                    memcpy(j->outs[flat], epk, 32);
+                    crypto_box_easy_afternm(j->outs[flat] + 32, j->ins[flat],
+                                            (unsigned long long)j->inlens[flat],
+                                            nonce, k);
+                    sodium_memzero(k, sizeof k);
+                }
+            }
+            sodium_memzero(esk, (size_t)nP * 32);
+            sodium_memzero(us, (size_t)nf * 32);
+            free(num); free(den); free(scr); free(esk); free(us);
+            return NULL;
+        }
+        free(num); free(den); free(scr); free(esk); free(us);
+        /* allocation pressure: fall through to the scalar path */
+    }
+    for (p = j->plo; p < j->phi; p++) {
+        for (c = 0; c < C; c++) {
+            Py_ssize_t flat = p * C + c;
+            if (crypto_box_seal(j->outs[flat], j->ins[flat],
+                                (unsigned long long)j->inlens[flat],
+                                j->pks + c * 32) != 0) {
+                j->fail = flat;
+                return NULL;
+            }
+        }
+    }
+    return NULL;
+}
+
+/* seal_participations(shares: list[list[bytes]] (P x C), pks: list[bytes32],
+ * n_threads=1) -> list[list[bytes]] */
+static PyObject *seal_participations(PyObject *self, PyObject *args) {
+    PyObject *shares, *pklist;
+    long n_threads = 1;
+    if (!PyArg_ParseTuple(args, "O!O!|l", &PyList_Type, &shares, &PyList_Type,
+                          &pklist, &n_threads))
+        return NULL;
+    Py_ssize_t P = PyList_Size(shares);
+    Py_ssize_t C = PyList_Size(pklist);
+    unsigned char *pks = NULL;
+    const unsigned char **ins = NULL;
+    Py_ssize_t *inlens = NULL;
+    unsigned char **outs = NULL;
+    comb_table *pts = NULL;
+    PyObject *pinned = NULL, *out = NULL;
+    Py_ssize_t total = P * C;
+
+    pks = PyMem_Malloc((size_t)(C ? C : 1) * 32);
+    if (!pks) return PyErr_NoMemory();
+    for (Py_ssize_t c = 0; c < C; c++) {
+        PyObject *item = PyList_GetItem(pklist, c);
+        char *buf; Py_ssize_t blen;
+        if (PyBytes_AsStringAndSize(item, &buf, &blen) < 0 ||
+            blen != crypto_box_PUBLICKEYBYTES) {
+            if (!PyErr_Occurred())
+                PyErr_Format(PyExc_ValueError, "public key %zd must be 32 bytes", c);
+            PyMem_Free(pks);
+            return NULL;
+        }
+        memcpy(pks + c * 32, buf, 32);
+    }
+    /* pin every share buffer with a strong ref (callers may mutate lists
+     * from another thread while the GIL is released below) */
+    pinned = PyList_New(total);
+    out = PyList_New(P);
+    ins = PyMem_Malloc(sizeof(*ins) * (size_t)(total ? total : 1));
+    inlens = PyMem_Malloc(sizeof(*inlens) * (size_t)(total ? total : 1));
+    outs = PyMem_Malloc(sizeof(*outs) * (size_t)(total ? total : 1));
+    if (!pinned || !out || !ins || !inlens || !outs) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    for (Py_ssize_t p = 0; p < P; p++) {
+        PyObject *row = PyList_GetItem(shares, p);
+        if (!PyList_Check(row) || PyList_Size(row) != C) {
+            PyErr_Format(PyExc_ValueError,
+                         "shares[%zd] must be a list of %zd messages", p, C);
+            goto fail;
+        }
+        PyObject *orow = PyList_New(C);
+        if (!orow) goto fail;
+        PyList_SET_ITEM(out, p, orow);
+        for (Py_ssize_t c = 0; c < C; c++) {
+            PyObject *item = PyList_GetItem(row, c);
+            char *buf; Py_ssize_t blen;
+            if (PyBytes_AsStringAndSize(item, &buf, &blen) < 0) goto fail;
+            Py_INCREF(item);
+            PyList_SET_ITEM(pinned, p * C + c, item);
+            PyObject *res = PyBytes_FromStringAndSize(NULL,
+                                                      blen + crypto_box_SEALBYTES);
+            if (!res) goto fail;
+            PyList_SET_ITEM(orow, c, res);
+            ins[p * C + c] = (const unsigned char *)buf;
+            inlens[p * C + c] = blen;
+            outs[p * C + c] = (unsigned char *)PyBytes_AS_STRING(res);
+        }
+    }
+    if (total >= SDA_COMB_MIN_BATCH && C > 0) {
+        pts = PyMem_Malloc(sizeof(comb_table) * (size_t)C);
+        if (pts) {
+            if (!g_base_table_ready) {
+                sda_comb_table_base(&g_base_table);
+                g_base_table_ready = 1;
+            }
+            for (Py_ssize_t c = 0; c < C; c++) {
+                if (sda_comb_table_from_u(&pts[c], pks + c * 32) != 0) {
+                    PyMem_Free(pts); /* some pk does not lift: scalar path */
+                    pts = NULL;
+                    break;
+                }
+            }
+        }
+    }
+    {
+        Py_ssize_t first_fail = -1;
+        if (n_threads < 1) n_threads = 1;
+        if (n_threads > P) n_threads = P ? P : 1;
+        if (n_threads > SEAL_MAX_THREADS) n_threads = SEAL_MAX_THREADS;
+        Py_BEGIN_ALLOW_THREADS
+        if (n_threads <= 1) {
+            partjob_t job = {0, P, C, ins, inlens, outs, pks, pts, -1};
+            participations_worker(&job);
+            first_fail = job.fail;
+        } else {
+            partjob_t jobs[SEAL_MAX_THREADS];
+            pthread_t tids[SEAL_MAX_THREADS];
+            int started[SEAL_MAX_THREADS];
+            Py_ssize_t chunk = (P + n_threads - 1) / n_threads;
+            for (long t = 0; t < n_threads; t++) {
+                Py_ssize_t lo = t * chunk;
+                Py_ssize_t hi = lo + chunk < P ? lo + chunk : P;
+                partjob_t j = {lo, hi, C, ins, inlens, outs, pks, pts, -1};
+                jobs[t] = j;
+                started[t] = pthread_create(&tids[t], NULL, participations_worker,
+                                            &jobs[t]) == 0;
+                if (!started[t]) participations_worker(&jobs[t]);
+            }
+            for (long t = 0; t < n_threads; t++) {
+                if (started[t]) pthread_join(tids[t], NULL);
+                if (jobs[t].fail >= 0 &&
+                    (first_fail < 0 || jobs[t].fail < first_fail))
+                    first_fail = jobs[t].fail;
+            }
+        }
+        Py_END_ALLOW_THREADS
+        if (first_fail >= 0) {
+            PyErr_Format(PyExc_RuntimeError, "crypto_box_seal failed");
+            goto fail;
+        }
+    }
+    PyMem_Free(pts);
+    PyMem_Free(pks);
+    PyMem_Free(ins); PyMem_Free(inlens); PyMem_Free(outs);
+    Py_DECREF(pinned);
+    return out;
+fail:
+    PyMem_Free(pts);
+    PyMem_Free(pks);
+    PyMem_Free(ins); PyMem_Free(inlens); PyMem_Free(outs);
+    Py_XDECREF(pinned);
+    Py_XDECREF(out);
+    return NULL;
 }
 
 /* ---------------- ChaCha20 mask expansion ----------------
@@ -403,6 +744,8 @@ static PyMethodDef methods[] = {
      "decode a zigzag-LEB128 stream to little-endian int64 bytes"},
     {"seal_batch", seal_batch, METH_VARARGS, "sealed-box encrypt a batch"},
     {"open_batch", open_batch, METH_VARARGS, "sealed-box decrypt a batch"},
+    {"seal_participations", seal_participations, METH_VARARGS,
+     "seal P x C share matrix to C clerk keys, one ephemeral per participant"},
     {"chacha_expand", chacha_expand, METH_VARARGS,
      "expand one 32-byte ChaCha20 key to int64 mask bytes mod m"},
     {"chacha_combine", chacha_combine, METH_VARARGS,

@@ -107,6 +107,17 @@ def modmatmul_np(A: np.ndarray, B: np.ndarray, m: int) -> np.ndarray:
         return np.vectorize(lambda v: rust_rem_int(int(v), m), otypes=[np.int64])(out)
     A = np.asarray(A, dtype=np.int64)
     B = np.asarray(B, dtype=np.int64)
+    # np.abs(INT64_MIN) wraps back to INT64_MIN (negative), which would
+    # poison the magnitude bound below into blessing a fast path whose
+    # products can't even be formed in int64 (INT64_MIN * anything wraps
+    # before any reduction can run, including the per-product path's).
+    # Pre-reduce such operands into (-m, m): same residues, and the
+    # resulting magnitudes (< m < 2**31) make every later bound exact.
+    int64_min = np.iinfo(np.int64).min
+    if (A == int64_min).any():
+        A = rust_rem_np(A, m)
+    if (B == int64_min).any():
+        B = rust_rem_np(B, m)
     # the K-sum of raw products is bounded by K*max|A|*max|B|, so when
     # that fits the arithmetic the per-product reduction (two fmod
     # passes over a (..., K, N) intermediate — the host protocol plane's

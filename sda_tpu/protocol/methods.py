@@ -72,6 +72,16 @@ class SdaService(abc.ABC):
     def create_participation(self, caller, participation) -> None:
         """Submit a participation (caller must be the participant)."""
 
+    def create_participations(self, caller, participations) -> None:
+        """Submit a batch of participations (caller must be the participant
+        of every one).  Both shipped bindings (the in-process service and
+        the REST client's batch route) make the batch atomic: every
+        participation is accepted — idempotent replays included — or none
+        is stored.  This default is only a compatibility shim for
+        third-party bindings and submits sequentially, without atomicity."""
+        for participation in participations:
+            self.create_participation(caller, participation)
+
     # -- clerking (methods.rs:76-84) -----------------------------------------
 
     @abc.abstractmethod

@@ -188,6 +188,18 @@ class SdaHttpClient(SdaService):
     def create_participation(self, caller, participation) -> None:
         self._request("POST", "/v1/aggregations/participations", caller, participation)
 
+    def create_participations(self, caller, participations) -> None:
+        """Batched submit: the whole array in one request on the batch
+        route — one auth check, one response, one store transaction —
+        over the session's persistent keep-alive connection. Overrides
+        the interface's sequential (non-atomic) default."""
+        self._request(
+            "POST",
+            "/v1/aggregations/participations/batch",
+            caller,
+            [p.to_json() for p in participations],
+        )
+
     # -- clerking -----------------------------------------------------------
 
     def get_clerking_job(self, caller, clerk_id):

@@ -18,6 +18,7 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
     POST   /v1/aggregations/implied/committee
     GET    /v1/aggregations/{AggregationId}/committee
     POST   /v1/aggregations/participations
+    POST   /v1/aggregations/participations/batch   (additive; JSON array)
     GET    /v1/aggregations/{AggregationId}/status
     POST   /v1/aggregations/implied/snapshot
     GET    /v1/aggregations/any/jobs
@@ -268,6 +269,22 @@ class _Handler(BaseHTTPRequestHandler):
             svc.create_participation(
                 self._caller(), self._read(Participation.from_json)
             )
+            self._send(201)
+            return True
+
+        if method == "POST" and path == "/v1/aggregations/participations/batch":
+            # batched ingest (additive route, not in the reference): a JSON
+            # array of participations, ONE auth check and ONE response for
+            # the whole batch — the transport half of the pipeline. The
+            # service layer accepts or rejects the array atomically.
+            payload = self._read_json()
+            if not isinstance(payload, list):
+                raise InvalidRequestError("expected a JSON array of participations")
+            try:
+                participations = [Participation.from_json(p) for p in payload]
+            except Exception as e:
+                raise InvalidRequestError(f"malformed body: {e}")
+            svc.create_participations(self._caller(), participations)
             self._send(201)
             return True
 

@@ -173,6 +173,34 @@ class FileAggregationsStore(AggregationsStore):
             participation.to_json(),
         )
 
+    def create_participations(self, participations) -> None:
+        # validate the whole batch (aggregation existence + conflicts)
+        # before the first write, so a mid-batch reject leaves no partial
+        # state from *this* batch. File-per-object gives no multi-file
+        # transaction: a crash mid-loop can still persist a prefix, which
+        # is exactly the durability model of N single uploads (each
+        # already-written file is a valid, idempotently replayable row).
+        participations = list(participations)
+        staged: dict = {}
+        dirs: dict = {}
+        for p in participations:
+            if p.aggregation not in dirs:
+                if self.aggregations.get(p.aggregation) is None:
+                    raise InvalidRequestError(f"no aggregation {p.aggregation}")
+                dirs[p.aggregation] = self._participations(p.aggregation)
+            payload = p.to_json()
+            prev = staged.get(p.id)
+            if prev is not None and prev[1] != payload:
+                raise ServerError(f"object already exists: {p.id}")
+            existing = dirs[p.aggregation].get(p.id)
+            if existing is not None and existing != payload:
+                raise ServerError(f"object already exists: {p.id}")
+            staged[p.id] = (p.aggregation, payload)
+        for pid, (agg, payload) in staged.items():
+            # _create (not put): keeps the per-directory lock's conflict
+            # check against writers racing this batch
+            _create(dirs[agg], pid, payload)
+
     def create_snapshot(self, snapshot) -> None:
         _create(self._snapshots(snapshot.aggregation), snapshot.id, snapshot.to_json())
 

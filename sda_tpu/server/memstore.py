@@ -150,6 +150,25 @@ class MemAggregationsStore(AggregationsStore):
                 raise InvalidRequestError(f"no aggregation {agg}")
             _create_if_identical(self._participations[agg], participation.id, participation)
 
+    def create_participations(self, participations) -> None:
+        # atomic batch: validate everything under the lock, then commit —
+        # a mid-batch conflict/missing aggregation leaves no partial state
+        participations = list(participations)
+        with self._lock:
+            staged: dict = {}
+            for p in participations:
+                if p.aggregation not in self._aggregations:
+                    raise InvalidRequestError(f"no aggregation {p.aggregation}")
+                prev = staged.get(p.id)
+                if prev is not None and prev != p:
+                    raise ServerError(f"object already exists: {p.id}")
+                existing = self._participations[p.aggregation].get(p.id)
+                if existing is not None and existing != p:
+                    raise ServerError(f"object already exists: {p.id}")
+                staged[p.id] = p
+            for p in staged.values():
+                self._participations[p.aggregation][p.id] = p
+
     def create_snapshot(self, snapshot) -> None:
         with self._lock:
             self._snapshots.setdefault(snapshot.aggregation, {})

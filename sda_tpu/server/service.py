@@ -189,13 +189,12 @@ class SdaServer:
                 )
         self.aggregation_store.create_committee(committee)
 
-    def create_participation(self, participation) -> None:
+    def _validate_participation(self, participation, committee, agg) -> None:
         # Validate the clerk-encryption list against the committee: the
         # snapshot transpose routes ciphertexts to clerks *by position*
         # (stores.iter_snapshot_clerk_jobs_data), so a short/long/misordered
         # list would crash snapshotting or silently corrupt the aggregate.
         # (The reference accepts these unchecked — a deliberate hardening.)
-        committee = self.aggregation_store.get_committee(participation.aggregation)
         if committee is None:
             raise InvalidRequestError("no committee for aggregation")
         expected = [clerk for (clerk, _) in committee.clerks_and_keys]
@@ -208,10 +207,32 @@ class SdaServer:
         # surface as an opaque clerk-side decrypt failure later
         if any(e.variant != "Sodium" for (_, e) in participation.clerk_encryptions):
             raise InvalidRequestError("clerk encryptions must be sodium sealed boxes")
-        self._validate_recipient_encryption(participation)
+        self._validate_recipient_encryption(participation, agg)
+
+    def create_participation(self, participation) -> None:
+        committee = self.aggregation_store.get_committee(participation.aggregation)
+        agg = self.aggregation_store.get_aggregation(participation.aggregation)
+        self._validate_participation(participation, committee, agg)
         self.aggregation_store.create_participation(participation)
 
-    def _validate_recipient_encryption(self, participation) -> None:
+    def create_participations(self, participations) -> None:
+        """Batched ingest: every item passes the exact single-item checks
+        (committee order, sodium variants, recipient-ciphertext shape),
+        with committee/aggregation lookups amortized per aggregation, then
+        ONE bulk store write — which rejects atomically, so one invalid
+        participation stores nothing from the batch."""
+        participations = list(participations)
+        committees: dict = {}
+        aggs: dict = {}
+        for p in participations:
+            a = p.aggregation
+            if a not in committees:
+                committees[a] = self.aggregation_store.get_committee(a)
+                aggs[a] = self.aggregation_store.get_aggregation(a)
+            self._validate_participation(p, committees[a], aggs[a])
+        self.aggregation_store.create_participations(participations)
+
+    def _validate_recipient_encryption(self, participation, agg) -> None:
         """Shape-check the recipient (mask) ciphertext at the door. For
         Paillier the wire format is public, so a garbage blob — which would
         otherwise surface only at snapshot-combine or recipient-decrypt
@@ -223,7 +244,6 @@ class SdaServer:
         enc = participation.recipient_encryption
         if enc is None:
             return
-        agg = self.aggregation_store.get_aggregation(participation.aggregation)
         if agg is None:
             return  # caller's store write will surface the missing aggregation
         scheme = agg.recipient_encryption_scheme
@@ -324,8 +344,12 @@ class SdaServer:
         # body leaks a prefix-length timing oracle on a network-facing auth
         # path. The reference itself compares with == (server.rs:174-186);
         # this is a deliberate hardening deviation (docs/security.md).
+        # Compared as the body's canonical BYTES: a str() coercion would
+        # make any non-string body with a matching repr authenticate (e.g.
+        # a list whose repr equals the stored secret), and would diverge
+        # from what register_auth_token actually persisted.
         if stored is not None and hmac.compare_digest(
-            str(stored.body).encode(), str(token.body).encode()
+            _token_body_bytes(stored.body), _token_body_bytes(token.body)
         ):
             agent = self.agents_store.get_agent(token.id)
             if agent is None:
@@ -335,6 +359,17 @@ class SdaServer:
 
     def delete_auth_token(self, agent_id) -> None:
         self.auth_tokens_store.delete_auth_token(agent_id)
+
+
+def _token_body_bytes(body) -> bytes:
+    """Canonical byte encoding of an auth-token secret. Only the two wire
+    shapes are comparable; anything else fails closed as a bad credential
+    rather than being repr()-flattened into something comparable."""
+    if isinstance(body, bytes):
+        return bytes(body)
+    if isinstance(body, str):
+        return body.encode("utf-8")
+    raise InvalidCredentialsError("malformed auth token")
 
 
 def _acl_agent_is(caller, agent_id) -> None:
@@ -427,6 +462,14 @@ class SdaServerService(SdaService):
     def create_participation(self, caller, participation) -> None:
         _acl_agent_is(caller, participation.participant)
         self.server.create_participation(participation)
+
+    def create_participations(self, caller, participations) -> None:
+        # the same ACL gate as singles, applied to EVERY item before any
+        # validation or storage work happens
+        participations = list(participations)
+        for p in participations:
+            _acl_agent_is(caller, p.participant)
+        self.server.create_participations(participations)
 
     # -- clerking --------------------------------------------------------------
 

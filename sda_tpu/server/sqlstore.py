@@ -376,6 +376,58 @@ class SqliteAggregationsStore(AggregationsStore):
             },
         )
 
+    def create_participations(self, participations) -> None:
+        """Bulk ingest: ONE write transaction for the whole batch.
+
+        The single-row path pays a BEGIN IMMEDIATE + existence probe +
+        SELECT + INSERT per participation; here the batch shares one
+        transaction, one aggregation probe per distinct aggregation, a
+        chunked IN() duplicate scan, and one executemany (sqlite3 reuses
+        the prepared INSERT across the whole sequence). Semantics match
+        N singles: identical replays no-op, a same-id-different-body
+        conflict or missing aggregation raises and the transaction's
+        rollback discards every row of the batch."""
+        participations = list(participations)
+        if not participations:
+            return
+        # canonicalize + intra-batch dedup before taking the write lock
+        rows: dict = {}
+        for p in participations:
+            key = str(p.id)
+            body = json.dumps(p.to_json())
+            prev = rows.get(key)
+            if prev is not None and prev[2] != body:
+                raise ServerError(f"object already exists: {key}")
+            rows[key] = (key, str(p.aggregation), body)
+        with self.db.transaction() as conn:
+            for agg in sorted({r[1] for r in rows.values()}):
+                if (
+                    conn.execute(
+                        "SELECT 1 FROM aggregations WHERE id = ?", (agg,)
+                    ).fetchone()
+                    is None
+                ):
+                    raise InvalidRequestError(f"no aggregation {agg}")
+            fresh = dict(rows)
+            ids = list(rows)
+            chunk = 500  # stay under SQLITE_MAX_VARIABLE_NUMBER (999 legacy)
+            for lo in range(0, len(ids), chunk):
+                part = ids[lo : lo + chunk]
+                marks = ",".join("?" * len(part))
+                for id_, body in conn.execute(
+                    f"SELECT id, body FROM participations WHERE id IN ({marks})",
+                    part,
+                ):
+                    if body != rows[id_][2]:
+                        raise ServerError(f"object already exists: {id_}")
+                    fresh.pop(id_, None)  # identical replay: no-op
+            if fresh:
+                conn.executemany(
+                    "INSERT INTO participations (id, aggregation, body) "
+                    "VALUES (?, ?, ?)",
+                    list(fresh.values()),
+                )
+
     def create_snapshot(self, snapshot) -> None:
         self.db.create_row(
             "snapshots",

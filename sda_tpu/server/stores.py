@@ -94,6 +94,20 @@ class AggregationsStore(BaseStore):
     @abc.abstractmethod
     def create_participation(self, participation) -> None: ...
 
+    def create_participations(self, participations) -> None:
+        """Bulk write of pre-validated participations — the storage half of
+        the batched ingest pipeline.
+
+        Contract: ATOMIC with the same create-if-identical idempotence as
+        singles.  If any participation conflicts (same id, different body)
+        or its aggregation is missing, the whole batch must be rejected
+        with no partial state.  Backends override with a real bulk write
+        (sqlite: one BEGIN IMMEDIATE + executemany); this default serves
+        backends whose single create is already an in-memory mutation that
+        the caller serializes (and is made atomic there by pre-checking)."""
+        for participation in participations:
+            self.create_participation(participation)
+
     @abc.abstractmethod
     def create_snapshot(self, snapshot) -> None: ...
 

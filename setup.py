@@ -29,7 +29,8 @@ setup(
             "sda_tpu.native._sdanative",
             sources=["sda_tpu/native/_sdanative.c"],
             extra_link_args=["-l:libsodium.so.23"],
-            extra_compile_args=["-O2"],
+            extra_compile_args=["-O3"],
+            depends=["sda_tpu/native/curve25519_comb.c"],
         )
     ],
 )

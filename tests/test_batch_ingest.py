@@ -1,0 +1,176 @@
+"""Batched participation ingest: batch-of-N must be indistinguishable
+from N singles across every store backend and both service bindings.
+
+The matrix is driven directly (monkeypatched SDA_TEST_STORE/SDA_TEST_HTTP
+around ``with_service``) instead of relying on the suite-level env switch,
+so one plain `pytest` run covers mem/file/sqlite x in-process/REST — the
+exact surface the batch route, the bulk store writes, and the service-side
+batch validation added for the ingest pipeline must keep equivalent.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup, with_service
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    InvalidRequestError,
+    NoMasking,
+    PermissionDeniedError,
+    SdaError,
+    SodiumEncryptionScheme,
+)
+
+MATRIX = [
+    (store, http)
+    for store in ("mem", "file", "sqlite")
+    for http in (False, True)
+]
+
+
+def _configure(monkeypatch, store: str, http: bool) -> None:
+    if store == "mem":
+        monkeypatch.delenv("SDA_TEST_STORE", raising=False)
+    else:
+        monkeypatch.setenv("SDA_TEST_STORE", store)
+    monkeypatch.setenv("SDA_TEST_HTTP", "1" if http else "0")
+
+
+def _setup(tmp_path, service):
+    recipient, rkey, _clerks = new_committee_setup(tmp_path, service, n_clerks=3)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="batch-ingest",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id)
+    participant = new_client(tmp_path / "participant", service)
+    participant.upload_agent()
+    return recipient, agg, participant
+
+
+def _count(service, recipient, agg_id) -> int:
+    return service.get_aggregation_status(
+        recipient.agent, agg_id
+    ).number_of_participations
+
+
+@pytest.mark.parametrize("store,http", MATRIX)
+def test_batch_equals_singles_and_replay(tmp_path, monkeypatch, store, http):
+    """Batch of N stores exactly what N singles would, full-batch replay
+    is an idempotent no-op, and an intra-batch identical duplicate
+    collapses to one row — on every backend and binding."""
+    _configure(monkeypatch, store, http)
+    with with_service() as ctx:
+        recipient, agg, participant = _setup(tmp_path, ctx.service)
+
+        batch = participant.new_participations(
+            [[i % 5, 2, 3, 4] for i in range(8)], agg.id
+        )
+        participant.upload_participations(batch)
+        assert _count(ctx.service, recipient, agg.id) == 8
+
+        singles = participant.new_participations(
+            [[i % 5, 4, 3, 2] for i in range(8)], agg.id
+        )
+        for p in singles:
+            participant.upload_participation(p)
+        assert _count(ctx.service, recipient, agg.id) == 16
+
+        # idempotent replay: the whole batch again, and a singles item
+        # through the batch route — both no-ops
+        participant.upload_participations(batch)
+        participant.upload_participations([singles[0]])
+        assert _count(ctx.service, recipient, agg.id) == 16
+
+        # intra-batch identical duplicate: same as uploading it twice
+        dup = participant.new_participations([[9, 9, 9, 9]], agg.id)[0]
+        participant.upload_participations([dup, dup])
+        assert _count(ctx.service, recipient, agg.id) == 17
+
+
+@pytest.mark.parametrize("store,http", MATRIX)
+def test_batch_mid_invalid_rejects_atomically(tmp_path, monkeypatch, store, http):
+    """One bad item anywhere in the batch rejects the WHOLE batch: no
+    prefix of valid items may land (the singles loop's partial-progress
+    behavior is exactly what the atomic batch contract removes)."""
+    _configure(monkeypatch, store, http)
+    with with_service() as ctx:
+        recipient, agg, participant = _setup(tmp_path, ctx.service)
+
+        stored = participant.new_participations([[1, 1, 1, 1]], agg.id)[0]
+        participant.upload_participation(stored)
+        assert _count(ctx.service, recipient, agg.id) == 1
+
+        fresh = participant.new_participations(
+            [[2, 2, 2, 2], [3, 3, 3, 3], [4, 4, 4, 4]], agg.id
+        )
+        # middle item re-uses a stored id with a different body -> conflict
+        fresh[1].id = stored.id
+        with pytest.raises(SdaError):
+            participant.upload_participations(fresh)
+        assert _count(ctx.service, recipient, agg.id) == 1
+
+        # conflicting duplicate WITHIN one batch: same id, different body
+        a, b = participant.new_participations(
+            [[5, 5, 5, 5], [6, 6, 6, 6]], agg.id
+        )
+        b.id = a.id
+        with pytest.raises(SdaError):
+            participant.upload_participations([a, b])
+        assert _count(ctx.service, recipient, agg.id) == 1
+
+        # unknown aggregation anywhere in the batch -> invalid request,
+        # nothing stored
+        good = participant.new_participations([[7, 7, 7, 7]], agg.id)
+        bad = copy.deepcopy(good[0])
+        bad.aggregation = AggregationId.random()
+        with pytest.raises(InvalidRequestError):
+            participant.upload_participations(good + [bad])
+        assert _count(ctx.service, recipient, agg.id) == 1
+
+
+@pytest.mark.parametrize("http", [False, True])
+def test_batch_acl_rejects_foreign_participation(tmp_path, monkeypatch, http):
+    """The batch route runs the same per-item ACL as singles: a caller
+    smuggling someone else's participation into their batch is denied
+    before anything is stored."""
+    _configure(monkeypatch, "mem", http)
+    with with_service() as ctx:
+        recipient, agg, participant = _setup(tmp_path, ctx.service)
+        other = new_client(tmp_path / "other", ctx.service)
+        other.upload_agent()
+
+        mine = participant.new_participations([[1, 2, 3, 4]], agg.id)
+        theirs = other.new_participations([[4, 3, 2, 1]], agg.id)
+        with pytest.raises(PermissionDeniedError):
+            ctx.service.create_participations(
+                participant.agent, mine + theirs
+            )
+        assert _count(ctx.service, recipient, agg.id) == 0
+
+
+@pytest.mark.parametrize("store,http", [("sqlite", True), ("mem", False)])
+def test_participate_many_pipelined(tmp_path, monkeypatch, store, http):
+    """The client's chunked build/upload pipeline lands every value
+    exactly once and returns one id per value."""
+    _configure(monkeypatch, store, http)
+    with with_service() as ctx:
+        recipient, agg, participant = _setup(tmp_path, ctx.service)
+        values = [[i % 5, (i + 1) % 5, 0, 1] for i in range(10)]
+        ids = participant.participate_many(values, agg.id, chunk_size=4)
+        assert len(ids) == len(set(ids)) == 10
+        assert _count(ctx.service, recipient, agg.id) == 10

@@ -42,6 +42,9 @@ def _cpu_bench_env():
     # must not change which code path each test exercises
     env.pop("SDA_BENCH_PROBE", None)
     env.pop("SDA_BENCH_DEADLINE", None)
+    # test subprocesses must not litter bench-artifacts/ with ingest
+    # rider artifacts (stdout metric lines still exercise the rider)
+    env["SDA_BENCH_ARTIFACTS"] = "0"
     return repo, env
 
 
@@ -195,9 +198,13 @@ def test_bench_crash_emits_error_metric():
         capture_output=True, text=True, env=env, cwd=repo, timeout=240,
     )
     assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+    # every stdout line is machine-readable JSON (the ingest rider's
+    # per-plane lines may precede the verdict); the LAST line is the
+    # run's error-tagged metric line — never a raw traceback on stdout
     stdout_lines = out.stdout.strip().splitlines()
-    assert len(stdout_lines) == 1, out.stdout
-    line = json.loads(stdout_lines[0])
+    for raw in stdout_lines:
+        json.loads(raw)
+    line = json.loads(stdout_lines[-1])
     assert line["value"] == 0 and line["vs_baseline"] == 0.0
     assert "error" in line and line["error"]
     assert "Traceback" in out.stderr  # diagnosis preserved on stderr
@@ -225,12 +232,18 @@ def test_bench_probe_reports_unreachable_backend():
         capture_output=True, text=True, env=env, cwd=repo, timeout=240,
     )
     assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+    # stdout: rider plane lines, then the interim error line emitted right
+    # after the first failed attempt (wedge-proofing: a SIGKILL before
+    # give-up must still leave a parseable tail), then the final verdict —
+    # all JSON, last line wins
     stdout_lines = out.stdout.strip().splitlines()
-    assert len(stdout_lines) == 1, out.stdout
-    line = json.loads(stdout_lines[0])
+    assert len(stdout_lines) >= 2, out.stdout
+    for raw in stdout_lines:
+        json.loads(raw)
+    line = json.loads(stdout_lines[-1])
     assert line["value"] == 0 and "probe" in line["error"]
-    # a single attempt does not emit the schedule field
-    assert "probe_attempts" not in line
+    # even a single failed attempt carries the schedule now
+    assert len(line["probe_attempts"]) == 1, line
 
 
 def test_bench_probe_retries_within_deadline():
@@ -263,6 +276,57 @@ def test_bench_probe_retries_within_deadline():
     # hung") or fail fast after it ("probe failed") — either is a failure
     assert all("probe" in a["result"] for a in attempts)
     assert "retrying" in out.stderr
+
+
+def test_bench_sigkill_mid_retry_leaves_parseable_tail():
+    """The round-5 failure shape the wedge-proofing targets: the driver
+    SIGKILLs bench while the probe retry loop is still sleeping toward
+    its next attempt. The interim error line emitted after the FIRST
+    failed probe (refreshed every retry) must already be on stdout, so
+    the captured output's last line parses as an error-tagged metric
+    line carrying the attempt schedule — even though bench never reached
+    its own give-up emission."""
+    import json
+    import signal
+    import sys
+    import time
+
+    repo, env = _cpu_bench_env()
+    env["JAX_PLATFORMS"] = "nonexistent-backend"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-S", str(repo / "bench.py"),
+            "--participants", "2000", "--dim", "60", "--chunk", "1000",
+            # deadline leaves room for many retries: the kill lands in the
+            # ~30s sleep between attempt 1 and attempt 2
+            "--quick", "--probe", "2", "--deadline", "100000",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env, cwd=repo,
+    )
+    try:
+        # wait for the interim error line (probe failure surfaces within
+        # ~probe seconds + one jax import), then kill without warning
+        deadline = time.monotonic() + 180
+        captured = []
+        while time.monotonic() < deadline:
+            ln = proc.stdout.readline()
+            if ln.strip():
+                captured.append(ln.strip())
+                if '"error"' in ln:
+                    break
+        assert captured, "bench produced no stdout before the kill window"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    line = json.loads(captured[-1])
+    assert line["value"] == 0 and "probe" in line["error"]
+    assert len(line["probe_attempts"]) >= 1
+    # SIGKILL, not a clean exit: the give-up line never ran
+    assert proc.returncode == -signal.SIGKILL
 
 
 def test_rest_ingest_script_sqlite():

@@ -435,3 +435,19 @@ def test_uniform_mod_host_drbg_path(monkeypatch):
         assert calls == []
     det = uniform_mod_host((4096,), 433, entropy=lambda k: b"\x2a" * k)
     assert (det == det[0]).all()  # custom entropy: direct path, no seed mix
+
+
+def test_modmatmul_np_int64_min_entries_exact():
+    """np.abs(INT64_MIN) wraps back to INT64_MIN, so an operand holding it
+    used to poison the fast-path magnitude bound into blessing a matmul
+    whose raw products overflow. Such entries must take the pre-reduced
+    (robust) path and still produce exact residues."""
+    m = (1 << 31) - 1  # below MAX_SAFE_MODULUS: the int64 ladder runs
+    lo = np.iinfo(np.int64).min
+    A = np.array([[lo, 3], [2, lo]], dtype=np.int64)
+    B = np.array([[5, lo], [lo, 7]], dtype=np.int64)
+    got = modmatmul_np(A, B, m)
+    exact = A.astype(object) @ B.astype(object)
+    want = np.vectorize(lambda v: rust_rem_int(int(v), m), otypes=[np.int64])(exact)
+    np.testing.assert_array_equal(rust_rem_np(got, m) % m, want % m)
+    assert (np.abs(got) < m).all()  # representatives stay in (-m, m)
