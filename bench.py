@@ -43,10 +43,17 @@ import traceback
 
 import numpy as np
 
+from sda_tpu import telemetry
+
 
 NORTH_STAR_ELEMS_PER_S_PER_CHIP = (1_000_000 * 100_000) / 60.0 / 8.0
 
 METRIC_NAME = "packed_shamir_secure_sum_throughput_single_chip"
+
+#: one trace id for the whole run — bound in main() and stamped on every
+#: metric line, so stdout lines, the banked telemetry-<stamp>.json, and
+#: the server-side spans from the ingest riders all correlate
+RUN_TRACE_ID = telemetry.new_trace_id()
 
 #: v5e single-chip datasheet peaks, for the roofline fields (VERDICT r4
 #: #3): situate the achieved rate against hardware limits so "Nx target
@@ -124,6 +131,7 @@ def emit_final(line: dict) -> bool:
         if _FINAL_EMITTED:
             return False
         _FINAL_EMITTED = True
+    line.setdefault("trace_id", RUN_TRACE_ID)
     print(json.dumps(line), flush=True)
     return True
 
@@ -145,6 +153,7 @@ def emit_error(msg: str, final: bool = True) -> None:
         "unit": "shared_elements_per_second",
         "vs_baseline": 0.0,
         "error": msg,
+        "trace_id": RUN_TRACE_ID,
     }
     witnessed = _last_witnessed()
     if witnessed:
@@ -392,6 +401,7 @@ def _emit_ingest_line(plane: str, value, unit: str, baseline, extra: dict) -> No
         "value": value,
         "unit": unit,
         "vs_r5_baseline": round(value / baseline, 2) if baseline else None,
+        "trace_id": RUN_TRACE_ID,
         **extra,
     }
     print(json.dumps(line), flush=True)
@@ -530,6 +540,31 @@ def measure_batched_ingest(n_build: int = 600, n_singles: int = 150) -> dict:
             build_s = time.perf_counter() - t0
             if measure_build:
                 out["build_per_s"] = round(n_build / build_s)
+
+                # telemetry overhead guard: the same build with the
+                # measurement plane off vs on (acceptance bound: <2% —
+                # sealing dominates, counters are noise). The first
+                # build above paid one-time warmup (comb tables, lazy
+                # imports), so the A/B is a dedicated WARM pair.
+                def timed_build() -> float:
+                    t1 = time.perf_counter()
+                    participant.new_participations(
+                        [[1, 2, 3, 4]] * n_build, agg.id
+                    )
+                    return time.perf_counter() - t1
+
+                was_enabled = telemetry.enabled()
+                telemetry.set_enabled(False)
+                try:
+                    off_s = timed_build()
+                finally:
+                    telemetry.set_enabled(was_enabled)
+                on_s = timed_build()
+                out["build_per_s_telemetry_off"] = round(n_build / off_s)
+                out["build_per_s_telemetry_on"] = round(n_build / on_s)
+                out["telemetry_overhead_pct"] = round(
+                    (on_s - off_s) / off_s * 100.0, 2
+                )
             t0 = time.perf_counter()
             for p in batch[:n_singles]:
                 participant.upload_participation(p)
@@ -572,6 +607,8 @@ def measure_batched_ingest(n_build: int = 600, n_singles: int = 150) -> dict:
         None,
         {
             "participate_many_per_s": out["participate_many_per_s"],
+            "build_per_s_telemetry_off": out["build_per_s_telemetry_off"],
+            "telemetry_overhead_pct": out["telemetry_overhead_pct"],
             "roofline": {
                 "plane": "host_cpu",
                 "bound": "seal_and_share",
@@ -619,6 +656,15 @@ def measure_batched_ingest(n_build: int = 600, n_singles: int = 150) -> dict:
         here.mkdir(exist_ok=True)
         stamp = time.strftime("%Y%m%d-%H%M%S")
         (here / f"ingest-{stamp}.json").write_text(json.dumps(payload, indent=2))
+        # bank the run's telemetry plane alongside: every series the
+        # riders touched plus recent spans, keyed by the run trace id
+        (here / f"telemetry-{stamp}.json").write_text(
+            json.dumps(
+                {"trace_id": RUN_TRACE_ID, **telemetry.snapshot()},
+                indent=2,
+                default=repr,
+            )
+        )
     except OSError as exc:  # read-only checkout: keep the stdout evidence
         print(f"[bench] ingest artifact not written: {exc}", file=sys.stderr)
     return out
@@ -1566,6 +1612,9 @@ def run(args: argparse.Namespace, watchdog) -> int:
 
 def main() -> int:
     args = parse_args()
+    # bind the run trace id so client requests in the ingest riders carry
+    # X-SDA-Trace and server-side spans correlate with the metric lines
+    telemetry.set_trace_id(RUN_TRACE_ID)
     # host-plane rates first: pure CPU, independent of device health, and
     # attached to success AND error lines (SURVEY hard part #5 evidence)
     try:

@@ -14,24 +14,32 @@
 #      ladder at 1/100 participant scale, verification flags checked)
 #   2. scripts/simple-cli-example.sh — the reference walkthrough
 #      (docs/simple-cli-example.sh), expected `0 2 2 4 4 6 6 8 8 10`
-#   3. examples/ — both runnable end-to-end demos (federated training,
+#   3. scripts/check_metrics.py — live /v1/metrics scrape: drives a real
+#      client workload + engine step against a loopback REST stack, then
+#      fails unless the exposition parses and every core series
+#      (request/crypto/store/engine) is present with the run's trace id
+#      visible in server-side spans
+#   4. examples/ — both runnable end-to-end demos (federated training,
 #      federated analytics) must keep running as documented
 set -e
 cd "$(dirname "$0")"
 
-echo "=== ci 0/3: build native extension (Jenkinsfile 'build' stage) ==="
+echo "=== ci 0/4: build native extension (Jenkinsfile 'build' stage) ==="
 # in-place so the suite, bench.py, and the CLI all pick it up from the
 # checkout; the crypto plane falls back to Python if this fails, so a
 # missing toolchain degrades rates, not correctness
 python setup.py build_ext --inplace || echo "ci: native build failed; Python fallback paths will carry the crypto plane" >&2
 
-echo "=== ci 1/3: test suite + backend/binding matrix + ladder quick ==="
+echo "=== ci 1/4: test suite + backend/binding matrix + ladder quick ==="
 sh scripts/test-matrix.sh
 
-echo "=== ci 2/3: CLI acceptance walkthrough ==="
+echo "=== ci 2/4: CLI acceptance walkthrough ==="
 sh scripts/simple-cli-example.sh
 
-echo "=== ci 3/3: runnable examples (user-facing docs must not rot) ==="
+echo "=== ci 3/4: telemetry exposition gate (live /v1/metrics scrape) ==="
+JAX_PLATFORMS=cpu python scripts/check_metrics.py
+
+echo "=== ci 4/4: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null
 python examples/federated_analytics.py >/dev/null
 python examples/secure_sum_fabric.py >/dev/null

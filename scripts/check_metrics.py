@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""CI gate: scrape /v1/metrics from a live REST stack and fail loudly if
+the exposition stops parsing or the core series disappear.
+
+Spins up the real ThreadingHTTPServer on a loopback port (same process,
+so the process-global registry is the one the server samples), drives a
+small genuine workload through every instrumented layer — HTTP requests,
+store writes, crypto seals (client participation), and a CPU secure_sum
+for the engine series — then fetches the exposition over HTTP like a
+Prometheus scraper would and checks:
+
+1. every line obeys the text-format 0.0.4 line grammar;
+2. the core series exist with nonzero samples:
+   sda_http_requests_total, sda_store_op_seconds, sda_crypto_seals_total,
+   sda_engine_step_seconds.
+
+Run by ci.sh after the CLI walkthrough: JAX_PLATFORMS=cpu python
+scripts/check_metrics.py. Exit 0 on pass, 1 with a diagnostic on fail.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# text-format 0.0.4 line grammar; label values are quoted strings with
+# backslash escaping, so braces INSIDE a value (route templates like
+# "/v1/agents/{id}") are legal
+_LINE = re.compile(
+    r"^(?:"
+    r"# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r'(?:\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+    r" (?:[+-]?[0-9.eE+-]+|\+Inf|NaN)"
+    r")$"
+)
+
+REQUIRED_SERIES = [
+    "sda_http_requests_total",
+    "sda_store_op_seconds",
+    "sda_crypto_seals_total",
+    "sda_engine_step_seconds",
+]
+
+
+def drive_workload(base_url: str, tmp: str) -> None:
+    """A few real requests through client -> REST -> service -> store,
+    with enough crypto (participation sealing) to light the native series."""
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        NoMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+
+    def new_client(subdir):
+        keystore = Keystore(os.path.join(tmp, subdir))
+        service = SdaHttpClient(base_url, TokenStore(os.path.join(tmp, subdir)))
+        return SdaClient(SdaClient.new_agent(keystore), keystore, service)
+
+    recipient = new_client("recipient")
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="check-metrics",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+
+    clerks = [new_client(f"clerk{i}") for i in range(3)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+
+    participant = new_client("participant")
+    participant.upload_agent()
+    participant.participate([1, 2, 3, 4], agg.id)  # seals -> crypto series
+
+
+def drive_engine() -> None:
+    """One tiny CPU secure_sum so the engine series show up in the scrape."""
+    import jax
+    import jax.numpy as jnp
+
+    from sda_tpu.parallel.engine import TpuAggregator
+    from sda_tpu.protocol import AdditiveSharing
+
+    engine = TpuAggregator(AdditiveSharing(share_count=3, modulus=433), dim=8)
+    secrets = jnp.ones((4, 8), dtype=jnp.int32)
+    out = engine.secure_sum(secrets, jax.random.PRNGKey(0))
+    assert int(out[0]) == 4, "engine smoke sum disagrees"
+
+
+def check_exposition(text: str) -> list:
+    errors = []
+    if not text.endswith("\n"):
+        errors.append("exposition must end with a newline")
+    sampled = set()
+    for lineno, line in enumerate(text.rstrip("\n").split("\n"), 1):
+        if not _LINE.match(line):
+            errors.append(f"line {lineno} violates the text format: {line!r}")
+            continue
+        if not line.startswith("#"):
+            name = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", line).group(0)
+            sampled.add(re.sub(r"_(?:bucket|sum|count)$", "", name))
+            sampled.add(name)
+    for series in REQUIRED_SERIES:
+        if series not in sampled:
+            errors.append(f"required series missing from the scrape: {series}")
+    return errors
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from sda_tpu import telemetry
+    from sda_tpu.rest import serve_background
+    from sda_tpu.server import new_mem_server
+
+    if not telemetry.enabled():
+        print("check_metrics: SDA_TELEMETRY=0 in this environment", file=sys.stderr)
+        return 1
+
+    server = new_mem_server()
+    with serve_background(server) as base_url, tempfile.TemporaryDirectory() as tmp:
+        with telemetry.trace("ci-check-metrics"):
+            drive_workload(base_url, tmp)
+        drive_engine()
+        with urllib.request.urlopen(f"{base_url}/v1/metrics", timeout=30) as resp:
+            content_type = resp.headers.get("Content-Type", "")
+            body = resp.read().decode("utf-8")
+
+    errors = check_exposition(body)
+    if not content_type.startswith("text/plain"):
+        errors.append(f"unexpected Content-Type: {content_type!r}")
+    if not telemetry.spans(name="store.", trace_id="ci-check-metrics"):
+        errors.append("trace id did not propagate into store spans")
+
+    if errors:
+        print("check_metrics FAILED:", file=sys.stderr)
+        for err in errors:
+            print(f"  - {err}", file=sys.stderr)
+        return 1
+
+    lines = body.count("\n")
+    print(f"check_metrics OK: {lines} exposition lines, "
+          f"all of {', '.join(REQUIRED_SERIES)} present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,12 @@ a recommendation line — the evidence trail for changing bench defaults
 (e.g. ``--chunk``) between rounds. Partial runs are rate-bearing (the
 bench verifies what it measured before stopping), so they count, flagged.
 
+Also summarizes the batched-ingest rider artifacts
+(``bench-artifacts/ingest-<stamp>.json``, written by bench.py's
+measure_batched_ingest): host sealing, client build, and REST ingest
+rates plus the measured telemetry overhead, one row per run — the
+host-plane trend line next to the device-plane sweep table.
+
 Usage: python scripts/sweep_report.py [artifact_dir]
 """
 
@@ -44,6 +50,52 @@ def load(artdir: pathlib.Path):
     return rows
 
 
+#: rate/overhead columns lifted from each ingest artifact (absent keys —
+#: older artifacts — render as "-")
+_INGEST_COLS = (
+    "seal_batch_per_s",
+    "build_per_s",
+    "participate_many_per_s",
+    "rest_sqlite_batch_per_s",
+    "rest_mem_batch_per_s",
+    "telemetry_overhead_pct",
+)
+
+
+def load_ingest(artdir: pathlib.Path):
+    rows = []
+    for f in sorted(artdir.glob("ingest-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict) or all(d.get(k) is None for k in _INGEST_COLS):
+            continue  # no rate-bearing fields: nothing to tabulate
+        rows.append({"artifact": f.name, **{k: d.get(k) for k in _INGEST_COLS}})
+    return rows
+
+
+def print_ingest(rows) -> None:
+    print("\nbatched-ingest riders (ingest-*.json):")
+    print(
+        f"{'seal/s':>8} {'build/s':>8} {'many/s':>8} {'sqlite/s':>9} "
+        f"{'mem/s':>8} {'tel_ov%':>8}  artifact"
+    )
+    for r in rows:
+        cells = [
+            (r["seal_batch_per_s"], 8),
+            (r["build_per_s"], 8),
+            (r["participate_many_per_s"], 8),
+            (r["rest_sqlite_batch_per_s"], 9),
+            (r["rest_mem_batch_per_s"], 8),
+            (r["telemetry_overhead_pct"], 8),
+        ]
+        row = " ".join(
+            f"{v if v is not None else '-':>{w}}" for v, w in cells
+        )
+        print(f"{row}  {r['artifact']}")
+
+
 def tag_of(row):
     # prefer the metric line (bench.py records rng/chunk/check since r5,
     # ADVICE r4 #2); filename tag as fallback for pre-r5 artifacts
@@ -71,38 +123,46 @@ def tag_of(row):
 def main() -> int:
     artdir = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench-artifacts")
     rows = load(artdir)
-    if not rows:
-        print(f"no rate-bearing exp-*.json artifacts under {artdir}/", file=sys.stderr)
+    ingest_rows = load_ingest(artdir)
+    if not rows and not ingest_rows:
+        print(
+            f"no rate-bearing exp-*.json or ingest-*.json artifacts under {artdir}/",
+            file=sys.stderr,
+        )
         return 1
 
-    best: dict[tuple, dict] = {}
-    for r in rows:
-        key = tag_of(r)
-        if key not in best or r["value"] > best[key]["value"]:
-            best[key] = r
+    if rows:
+        best: dict[tuple, dict] = {}
+        for r in rows:
+            key = tag_of(r)
+            if key not in best or r["value"] > best[key]["value"]:
+                best[key] = r
 
-    print(f"{'rng':>9} {'chunk':>6} {'check':>6} {'elems/s':>12} "
-          f"{'steady_s':>9} {'partial':>7}  artifact")
-    for key in sorted(best, key=lambda k: tuple(x or "" for x in k)):
-        r = best[key]
-        rng, chunk, check = key
-        print(
-            f"{rng:>9} {chunk or '-':>6} {check:>6} {r['value']:>12.3e} "
-            f"{r['steady_s'] if r['steady_s'] is not None else float('nan'):>9} "
-            f"{'yes' if r['partial'] else 'no':>7}  {r['artifact']}"
-        )
+        print(f"{'rng':>9} {'chunk':>6} {'check':>6} {'elems/s':>12} "
+              f"{'steady_s':>9} {'partial':>7}  artifact")
+        for key in sorted(best, key=lambda k: tuple(x or "" for x in k)):
+            r = best[key]
+            rng, chunk, check = key
+            print(
+                f"{rng:>9} {chunk or '-':>6} {check:>6} {r['value']:>12.3e} "
+                f"{r['steady_s'] if r['steady_s'] is not None else float('nan'):>9} "
+                f"{'yes' if r['partial'] else 'no':>7}  {r['artifact']}"
+            )
 
-    # recommendation: fastest full-check config is eligible to become the
-    # bench default (the headline keeps the strongest verification); the
-    # fastest overall quantifies the scaffolding/rng headroom
-    full = [r for k, r in best.items() if k[2] == "full"]
-    if full:
-        top = max(full, key=lambda r: r["value"])
-        print(f"\nfastest full-check config: {tag_of(top)} at {top['value']:.3e} el/s "
-              f"({top['artifact']})")
-    top_any = max(best.values(), key=lambda r: r["value"])
-    print(f"fastest overall:           {tag_of(top_any)} at {top_any['value']:.3e} el/s "
-          f"({top_any['artifact']})")
+        # recommendation: fastest full-check config is eligible to become the
+        # bench default (the headline keeps the strongest verification); the
+        # fastest overall quantifies the scaffolding/rng headroom
+        full = [r for k, r in best.items() if k[2] == "full"]
+        if full:
+            top = max(full, key=lambda r: r["value"])
+            print(f"\nfastest full-check config: {tag_of(top)} at {top['value']:.3e} el/s "
+                  f"({top['artifact']})")
+        top_any = max(best.values(), key=lambda r: r["value"])
+        print(f"fastest overall:           {tag_of(top_any)} at {top_any['value']:.3e} el/s "
+              f"({top_any['artifact']})")
+
+    if ingest_rows:
+        print_ingest(ingest_rows)
     return 0
 
 

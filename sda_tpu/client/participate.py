@@ -16,8 +16,11 @@ clerk share across the whole batch is sealed in one engine call
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
+from .. import telemetry
 from ..protocol import Participation, ParticipationId
 from .keys import VerifiedKeys
 
@@ -43,17 +46,44 @@ class Participating(VerifiedKeys):
         ids: list = []
         errors: list = []
 
+        build_hist = telemetry.histogram(
+            "sda_client_chunk_seconds",
+            "participate_many per-chunk latency by stage",
+            stage="build",
+        )
+        upload_hist = telemetry.histogram(
+            "sda_client_chunk_seconds",
+            "participate_many per-chunk latency by stage",
+            stage="upload",
+        )
+        built_total = telemetry.counter(
+            "sda_client_participations_total",
+            "participations built by the batched client path",
+        )
+        # the upload rides a worker thread, which starts with a FRESH
+        # contextvars context — rebind the caller's trace id there so the
+        # batch POST still carries X-SDA-Trace
+        trace_id = telemetry.current_trace_id()
+
         def submit(batch):
+            if trace_id:
+                telemetry.set_trace_id(trace_id)
+            t0 = time.perf_counter()
             try:
                 self.upload_participations(batch)
             except BaseException as e:
                 errors.append(e)
+            finally:
+                upload_hist.observe(time.perf_counter() - t0)
 
         inflight = None
         for lo in range(0, len(values_list), chunk_size):
+            t0 = time.perf_counter()
             batch = self.new_participations(
                 values_list[lo : lo + chunk_size], aggregation_id
             )
+            build_hist.observe(time.perf_counter() - t0)
+            built_total.inc(len(batch))
             if inflight is not None:
                 inflight.join()
                 if errors:

@@ -1,7 +1,11 @@
 """sda_tpu.native — C acceleration layer with pure-Python fallbacks.
 
 ``available()`` reports whether the compiled extension loaded; the crypto
-modules route bulk work through here either way.
+modules route bulk work through here either way. Each bulk entry point
+counts its work into the telemetry plane labelled by the path actually
+taken (``comb`` / ``batch`` for the C plane, ``scalar`` / ``python`` for
+the fallbacks), so a scrape shows at a glance whether production traffic
+is riding the accelerated plane or silently falling back.
 """
 
 from __future__ import annotations
@@ -9,6 +13,8 @@ from __future__ import annotations
 import sys
 
 import numpy as np
+
+from .. import telemetry
 
 try:
     from . import _sdanative as _ext
@@ -57,13 +63,35 @@ def _default_threads() -> int:
     return os.cpu_count() or 1
 
 
+def _count_seals(n: int, path: str) -> None:
+    telemetry.counter(
+        "sda_crypto_seals_total", "sealed boxes produced by crypto path", path=path
+    ).inc(n)
+
+
+def _count_opens(n: int, path: str) -> None:
+    telemetry.counter(
+        "sda_crypto_opens_total", "sealed boxes opened by crypto path", path=path
+    ).inc(n)
+
+
+def _count_chacha(n: int, path: str) -> None:
+    telemetry.counter(
+        "sda_crypto_chacha_expands_total",
+        "ChaCha mask seeds expanded/combined by path",
+        path=path,
+    ).inc(n)
+
+
 def seal_batch(messages: list, public_key: bytes, n_threads: int | None = None) -> list:
     if _ext is not None:
+        _count_seals(len(messages), "batch")
         return _ext.seal_batch(
             list(messages), public_key, n_threads or _default_threads()
         )
     from ..crypto import sodium
 
+    _count_seals(len(messages), "scalar")
     return [sodium.seal(m, public_key) for m in messages]
 
 
@@ -71,11 +99,13 @@ def open_batch(
     ciphertexts: list, public_key: bytes, secret_key: bytes, n_threads: int | None = None
 ) -> list:
     if _ext is not None:
+        _count_opens(len(ciphertexts), "batch")
         return _ext.open_batch(
             list(ciphertexts), public_key, secret_key, n_threads or _default_threads()
         )
     from ..crypto import sodium
 
+    _count_opens(len(ciphertexts), "scalar")
     return [sodium.seal_open(c, public_key, secret_key) for c in ciphertexts]
 
 
@@ -90,7 +120,9 @@ def seal_participations(
     with per-clerk comb tables, so large batches seal at ~(1 + 1/C)
     comb-multiplications per share instead of two Montgomery ladders.
     Every output stays a standard ``crypto_box_seal`` sealed box."""
+    n = len(share_matrix) * len(public_keys)
     if _ext is not None:
+        _count_seals(n, "comb")
         return _ext.seal_participations(
             [list(row) for row in share_matrix],
             list(public_keys),
@@ -98,6 +130,7 @@ def seal_participations(
         )
     from ..crypto import sodium
 
+    _count_seals(n, "scalar")
     return [
         [sodium.seal(m, pk) for m, pk in zip(row, public_keys)]
         for row in share_matrix
@@ -121,10 +154,12 @@ def chacha_expand(seed_words, dim: int, modulus: int) -> np.ndarray:
     absent). Moduli above 2^63 raise in the fallback: int64 masks would
     wrap negative (no legal i64 scheme modulus reaches there)."""
     if _ext is not None and 0 < modulus <= (1 << 63):
+        _count_chacha(1, "native")
         buf = _ext.chacha_expand(_chacha_keys(seed_words), int(dim), int(modulus))
         return np.frombuffer(buf, dtype="<i8").copy()
     from ..ops.chacha import expand_seed
 
+    _count_chacha(1, "python")
     return expand_seed(np.asarray(seed_words, dtype=np.uint32), dim, modulus)
 
 
@@ -132,10 +167,14 @@ def chacha_combine(seed_rows, dim: int, modulus: int) -> np.ndarray:
     """Sum of every seed's expanded mask, elementwise mod modulus —
     the reveal hot loop, one C call for the whole cohort."""
     rows = np.asarray(seed_rows, dtype=np.uint32)
+    n_seeds = int(np.prod(rows.shape[:-1])) if rows.ndim > 1 else 1
     if _ext is not None and 0 < modulus <= (1 << 63):
+        _count_chacha(n_seeds, "native")
         buf = _ext.chacha_combine(_chacha_keys(rows), int(dim), int(modulus))
         return np.frombuffer(buf, dtype="<i8").copy()
     from ..ops.chacha import expand_seed
+
+    _count_chacha(n_seeds, "python")
 
     # uint64 accumulate: two values each < m can exceed int64 for moduli
     # above 2^62, but their uint64 sum is < 2^64 — identical to the C path

@@ -37,13 +37,53 @@ int64 only where products/sums require it. The int8-limb MXU path
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from .. import telemetry
 from ..ops import shamir
 from ..ops.jaxcfg import ensure_x64
 from ..protocol import AdditiveSharing, BasicShamirSharing, PackedShamirSharing
+
+
+def _step_hist(step: str):
+    return telemetry.histogram(
+        "sda_engine_step_seconds",
+        "secure_sum stage / sharded-fabric invocation timing (host "
+        "dispatch unless JAX blocks)",
+        step=step,
+    )
+
+
+def _instrument_fabric(fn, fabric: str, axis_size: int):
+    """Wrap a jitted sharded fabric fn(secrets, key): invocation timing
+    plus nominal psum traffic (result size x participant-axis size).
+
+    Transparent under tracing — ``verified_step`` re-jits over fabric
+    fns, and trace-time side effects would count compilations as
+    invocations — and under disabled telemetry.
+    """
+
+    def instrumented(secrets, key):
+        if not telemetry.enabled():
+            return fn(secrets, key)
+        import jax.core
+
+        if isinstance(secrets, jax.core.Tracer):
+            return fn(secrets, key)
+        t0 = time.perf_counter()
+        out = fn(secrets, key)
+        _step_hist(fabric).observe(time.perf_counter() - t0)
+        telemetry.counter(
+            "sda_engine_psum_bytes_total",
+            "nominal bytes moved per psum/all_to_all by sharded fabrics",
+            fabric=fabric,
+        ).inc(int(out.size) * out.dtype.itemsize * axis_size)
+        return out
+
+    return instrumented
 
 
 @dataclass(frozen=True)
@@ -284,11 +324,19 @@ class TpuAggregator:
     def secure_sum(self, secrets, key, indices=None):
         """(P, dim) -> (dim,) aggregate, all on device."""
         p = self.plan.modulus
-        shares = share_participants(secrets, key, self.plan, self.use_limbs)
-        sums = clerk_combine_mod(shares, p)
-        if indices is None:
-            indices = range(self.plan.share_count)
-        return reconstruct(sums, indices, self.scheme, self.dim)
+        with telemetry.span("engine.secure_sum", dim=self.dim):
+            t0 = time.perf_counter()
+            shares = share_participants(secrets, key, self.plan, self.use_limbs)
+            t1 = time.perf_counter()
+            _step_hist("share").observe(t1 - t0)
+            sums = clerk_combine_mod(shares, p)
+            t2 = time.perf_counter()
+            _step_hist("combine").observe(t2 - t1)
+            if indices is None:
+                indices = range(self.plan.share_count)
+            out = reconstruct(sums, indices, self.scheme, self.dim)
+            _step_hist("reconstruct").observe(time.perf_counter() - t2)
+        return out
 
     # -- sharded paths -------------------------------------------------------
 
@@ -338,7 +386,7 @@ class TpuAggregator:
             out_specs=P("p", None),
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return _instrument_fabric(jax.jit(mapped), "all_to_all", p_size)
 
     def _limb_accumulator_local_step(self, psum_axes):
         """Shared per-device body of the wide-modulus fabric: fused limb
@@ -394,7 +442,9 @@ class TpuAggregator:
             out_specs=P(None, "d", None),
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return _instrument_fabric(
+            jax.jit(mapped), "sharded_limb_accumulators", self.mesh.shape["p"]
+        )
 
     def sharded_clerk_sums(self):
         """Build the jitted sharded share+combine step over the mesh.
@@ -430,7 +480,9 @@ class TpuAggregator:
             out_specs=P(None, "d") if "d" in self.mesh.axis_names else P(),
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return _instrument_fabric(
+            jax.jit(mapped), "sharded_clerk_sums", self.mesh.shape["p"]
+        )
 
 
 

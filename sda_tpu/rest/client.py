@@ -10,11 +10,14 @@ protocol error types.
 from __future__ import annotations
 
 import json
+import re
+import time
 from typing import Optional
 from urllib.parse import quote, urlencode
 
 import requests
 
+from .. import telemetry
 from ..protocol import (
     Agent,
     Aggregation,
@@ -68,6 +71,11 @@ class SdaHttpClient(SdaService):
             # compact, like the reference client's serde_json bodies
             data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
             headers["Content-Type"] = "application/json"
+        trace_id = telemetry.current_trace_id() if telemetry.enabled() else None
+        if trace_id:
+            # propagate the caller's trace id so server-side spans join it
+            headers[telemetry.TRACE_HEADER] = trace_id
+        t0 = time.perf_counter()
         try:
             resp = self.session.request(
                 method, url, data=data, auth=auth, headers=headers,
@@ -78,6 +86,13 @@ class SdaHttpClient(SdaService):
             # surface — daemon loops (e.g. `sda clerk`) catch SdaError
             # and keep polling instead of dying on a transient stall
             raise SdaError(f"HTTP/REST transport failure: {exc}") from exc
+        if telemetry.enabled():
+            telemetry.histogram(
+                "sda_http_client_request_seconds",
+                "client-observed REST request latency by route template",
+                method=method,
+                route=re.sub(r"[0-9a-fA-F-]{36}", "{id}", path),
+            ).observe(time.perf_counter() - t0)
         return self._process(resp)
 
     @staticmethod

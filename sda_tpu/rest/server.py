@@ -24,6 +24,16 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
     GET    /v1/aggregations/any/jobs
     POST   /v1/aggregations/implied/jobs/{ClerkingJobId}/result
     GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result
+    GET    /v1/metrics        (additive; unauthenticated Prometheus text)
+    GET    /v1/metrics.json   (additive; unauthenticated telemetry snapshot)
+
+Observability: every request gets a fresh id, echoed as
+``X-SDA-Request-Id`` and stamped on 404/500 log lines; an incoming
+``X-SDA-Trace`` header is adopted for the handler thread (and echoed
+back), so server-side spans — dispatch, service, store — carry the
+client's trace id. Per-route request counts and latencies land in the
+telemetry registry under a normalized route template (uuid segments
+become ``{id}``). See docs/observability.md.
 
 Auth: HTTP Basic, username = AgentId, password = token recorded on first
 ``create_agent`` (trust-on-first-use, lib.rs:298-315). Missing resources are
@@ -44,8 +54,11 @@ import json
 import logging
 import re
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import telemetry
 from ..protocol import (
     Agent,
     AgentId,
@@ -73,6 +86,11 @@ _UUID = r"[0-9a-fA-F-]{36}"
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     service = None  # SdaServerService, set by make_handler
+
+    # per-request observability state, reset by _dispatch
+    _request_id = None
+    _trace_id = None
+    _status = None
 
     # -- plumbing -----------------------------------------------------------
 
@@ -132,11 +150,18 @@ class _Handler(BaseHTTPRequestHandler):
             raise InvalidRequestError(f"malformed body: {e}")
 
     def _send(self, status: int, body: bytes = b"", headers=()):
+        self._status = status
         self.send_response(status)
+        have_type = False
         for k, v in headers:
+            have_type = have_type or k.lower() == "content-type"
             self.send_header(k, v)
-        if body:
+        if body and not have_type:
             self.send_header("Content-Type", "application/json")
+        if self._request_id:
+            self.send_header("X-SDA-Request-Id", self._request_id)
+        if self._trace_id:
+            self.send_header(telemetry.TRACE_HEADER, self._trace_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if body:
@@ -163,11 +188,58 @@ class _Handler(BaseHTTPRequestHandler):
                 from urllib.parse import unquote_plus
 
                 params[k] = unquote_plus(v)
+
+        self._request_id = uuid.uuid4().hex[:16]
+        self._status = None
+        self._trace_id = None
+        if telemetry.enabled():
+            # adopt the client's trace id (or mint one) for this handler
+            # thread; echoed back by _send alongside the request id
+            self._trace_id = telemetry.sanitize_trace_id(
+                self.headers.get(telemetry.TRACE_HEADER)
+            ) or telemetry.new_trace_id()
+            telemetry.set_trace_id(self._trace_id)
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("http.request", method=method) as span_record:
+                handled = self._dispatch_inner(method, path, params)
+                route = re.sub(_UUID, "{id}", path) if handled else "<unmatched>"
+                if span_record is not None:
+                    span_record["attrs"] = {
+                        "method": method,
+                        "route": route,
+                        "status": self._status,
+                        "request_id": self._request_id,
+                    }
+            if telemetry.enabled():
+                telemetry.histogram(
+                    "sda_http_request_seconds",
+                    "REST request latency by route template",
+                    method=method,
+                    route=route,
+                ).observe(time.perf_counter() - t0)
+                telemetry.counter(
+                    "sda_http_requests_total",
+                    "REST requests served by route template and status",
+                    method=method,
+                    route=route,
+                    status=str(self._status or 0),
+                ).inc()
+        finally:
+            if self._trace_id is not None:
+                telemetry.set_trace_id(None)
+
+    def _dispatch_inner(self, method, path, params) -> bool:
+        """Route + error mapping; returns whether the path was routed."""
         try:
             handled = self._route(method, path, params)
             if not handled:
-                log.error("route not found: %s %s", method, path)
+                log.error(
+                    "route not found: %s %s (request %s)",
+                    method, path, self._request_id,
+                )
                 self._send(404)
+            return handled
         except InvalidCredentialsError as e:
             self._send(401, str(e).encode())
         except PermissionDeniedError as e:
@@ -175,8 +247,12 @@ class _Handler(BaseHTTPRequestHandler):
         except InvalidRequestError as e:
             self._send(400, str(e).encode())
         except Exception as e:  # ServerError and unexpected -> 500
-            log.error("%s %s -> 500: %s", method, path, e)
+            log.error(
+                "%s %s -> 500: %s (request %s)",
+                method, path, e, self._request_id,
+            )
             self._send(500, str(e).encode())
+        return True  # an error from a handler still means the path routed
 
     # -- routes -------------------------------------------------------------
 
@@ -189,11 +265,24 @@ class _Handler(BaseHTTPRequestHandler):
             return True
 
         if method == "GET" and path == "/v1/metrics":
-            # additive observability route (not in the reference protocol)
-            from ..utils.metrics import get_metrics
+            # additive observability route (not in the reference protocol):
+            # Prometheus text exposition, unauthenticated like /v1/ping —
+            # aggregate series only, no resource data (docs/observability.md)
+            body = telemetry.prometheus_text().encode("utf-8")
+            self._send(
+                200,
+                body,
+                headers=[("Content-Type", telemetry.PROMETHEUS_CONTENT_TYPE)],
+            )
+            return True
 
-            self._caller()
-            self._send_json_option(get_metrics().report())
+        if method == "GET" and path == "/v1/metrics.json":
+            # the same registry as JSON (plus recent spans), for tooling
+            # that wants telemetry.snapshot() without Prometheus parsing
+            body = json.dumps(
+                telemetry.snapshot(), separators=(",", ":"), default=repr
+            ).encode("utf-8")
+            self._send(200, body)
             return True
 
         if method == "POST" and path == "/v1/agents/me":

@@ -1,7 +1,13 @@
-"""sda_tpu.server — orchestration server, stores, snapshot pipeline."""
+"""sda_tpu.server — orchestration server, stores, snapshot pipeline.
+
+Every server constructor wraps its stores with the telemetry proxy
+(:mod:`.instrument`): op latency, rows written, and ``store.<op>`` spans
+come for free on all backends, labelled mem/file/sqlite.
+"""
 
 from __future__ import annotations
 
+from .instrument import instrument_store
 from .memstore import (
     MemAgentsStore,
     MemAggregationsStore,
@@ -19,15 +25,25 @@ from .stores import (
 )
 
 
-def new_mem_server() -> SdaServerService:
-    """In-memory server (tests / dev)."""
+def _server(store: str, agents, auths, aggs, jobs) -> SdaServerService:
     return SdaServerService(
         SdaServer(
-            agents_store=MemAgentsStore(),
-            auth_tokens_store=MemAuthTokensStore(),
-            aggregation_store=MemAggregationsStore(),
-            clerking_job_store=MemClerkingJobsStore(),
+            agents_store=instrument_store(agents, store),
+            auth_tokens_store=instrument_store(auths, store),
+            aggregation_store=instrument_store(aggs, store),
+            clerking_job_store=instrument_store(jobs, store),
         )
+    )
+
+
+def new_mem_server() -> SdaServerService:
+    """In-memory server (tests / dev)."""
+    return _server(
+        "mem",
+        MemAgentsStore(),
+        MemAuthTokensStore(),
+        MemAggregationsStore(),
+        MemClerkingJobsStore(),
     )
 
 
@@ -42,13 +58,12 @@ def new_file_server(path) -> SdaServerService:
 
     import os
 
-    return SdaServerService(
-        SdaServer(
-            agents_store=FileAgentsStore(os.path.join(path, "agents")),
-            auth_tokens_store=FileAuthTokensStore(os.path.join(path, "auths")),
-            aggregation_store=FileAggregationsStore(os.path.join(path, "agg")),
-            clerking_job_store=FileClerkingJobsStore(os.path.join(path, "jobs")),
-        )
+    return _server(
+        "file",
+        FileAgentsStore(os.path.join(path, "agents")),
+        FileAuthTokensStore(os.path.join(path, "auths")),
+        FileAggregationsStore(os.path.join(path, "agg")),
+        FileClerkingJobsStore(os.path.join(path, "jobs")),
     )
 
 
@@ -63,19 +78,19 @@ def new_sqlite_server(path) -> SdaServerService:
     )
 
     backend = SqliteBackend(path)
-    return SdaServerService(
-        SdaServer(
-            agents_store=SqliteAgentsStore(backend),
-            auth_tokens_store=SqliteAuthTokensStore(backend),
-            aggregation_store=SqliteAggregationsStore(backend),
-            clerking_job_store=SqliteClerkingJobsStore(backend),
-        )
+    return _server(
+        "sqlite",
+        SqliteAgentsStore(backend),
+        SqliteAuthTokensStore(backend),
+        SqliteAggregationsStore(backend),
+        SqliteClerkingJobsStore(backend),
     )
 
 
 __all__ = [
     "SdaServer",
     "SdaServerService",
+    "instrument_store",
     "new_mem_server",
     "new_file_server",
     "new_sqlite_server",

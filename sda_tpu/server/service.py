@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import telemetry
 from ..protocol import (
     AggregationStatus,
     InvalidCredentialsError,
@@ -334,6 +335,7 @@ class SdaServer:
         account by overwriting its token). Delegated to the store as one
         atomic check-and-write."""
         if not self.auth_tokens_store.register_auth_token(token):
+            _count_rejection("auth_token")
             raise InvalidCredentialsError("agent already registered")
 
     def check_auth_token(self, token):
@@ -353,8 +355,10 @@ class SdaServer:
         ):
             agent = self.agents_store.get_agent(token.id)
             if agent is None:
+                _count_rejection("auth_token")
                 raise InvalidCredentialsError("Agent not found")
             return agent
+        _count_rejection("auth_token")
         raise InvalidCredentialsError("invalid token")
 
     def delete_auth_token(self, agent_id) -> None:
@@ -372,8 +376,15 @@ def _token_body_bytes(body) -> bytes:
     raise InvalidCredentialsError("malformed auth token")
 
 
+def _count_rejection(check: str) -> None:
+    telemetry.counter(
+        "sda_acl_rejections_total", "denied service calls by ACL check", check=check
+    ).inc()
+
+
 def _acl_agent_is(caller, agent_id) -> None:
     if caller.id != agent_id:
+        _count_rejection("agent_is")
         raise PermissionDeniedError(f"caller {caller.id} is not {agent_id}")
 
 

@@ -1,0 +1,283 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+Design constraints (ISSUE 2): zero dependencies, enabled by default,
+cheap enough to sit on the REST/crypto/store hot paths, with an honest
+disabled-mode no-op (one attribute load + branch per operation).
+
+Hot-path writes go to *thread-local shards* — a per-thread dict of
+``key -> int`` for counters and ``key -> _HistCell`` for histograms — so
+the common case takes no lock at all (the GIL makes each individual dict
+update atomic). ``snapshot()`` merges every live shard plus the retired
+pool under one lock. Shards of dead threads are folded into the retired
+pool by a ``weakref.finalize`` on the thread-local holder (the same
+lifecycle trick ``native/bignum._Scratch`` uses for BN_CTX state), so a
+thread-per-request HTTP server does not leak a shard per request thread
+and totals stay exact across thread deaths.
+
+Metric identity is ``(name, sorted(label items))``. Handles are cached on
+the registry, so call sites may re-resolve ``counter(...)`` per event or
+hold the handle — holding it is cheaper and is what the instrumented hot
+paths do.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import weakref
+
+#: default histogram buckets (seconds): tuned for request/op latencies
+#: from ~100us (mem-store gets) to tens of seconds (engine steps)
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def _labels_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class _HistCell:
+    """Per-(shard, metric) histogram accumulator."""
+
+    __slots__ = ("counts", "sum", "count", "max")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1 for the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self.max = 0.0
+
+
+class _Shard:
+    """One thread's unlocked write buffer."""
+
+    __slots__ = ("counters", "hists", "__weakref__")
+
+    def __init__(self):
+        self.counters: dict = {}
+        self.hists: dict = {}
+
+
+class _ShardHolder:
+    """Lives in a ``threading.local`` slot; its collection (thread death)
+    triggers the finalizer that folds the shard into the retired pool."""
+
+    __slots__ = ("shard", "__weakref__")
+
+    def __init__(self, shard: _Shard):
+        self.shard = shard
+
+
+class Counter:
+    __slots__ = ("_registry", "name", "labels", "_key")
+
+    def __init__(self, registry: "Registry", name: str, labels: dict):
+        self._registry = registry
+        self.name = name
+        self.labels = dict(labels)
+        self._key = (name, _labels_key(labels))
+
+    def inc(self, delta: int = 1) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        counters = reg._shard().counters
+        key = self._key
+        counters[key] = counters.get(key, 0) + delta
+
+    def value(self) -> int:
+        """Merged current value (snapshot-priced; not for hot paths)."""
+        return self._registry.snapshot()["counters"].get(self._key, 0)
+
+
+class Gauge:
+    """Last-write-wins; writes go straight to a registry-level dict
+    (one GIL-atomic store — no shard needed, merging gauges is meaningless)."""
+
+    __slots__ = ("_registry", "name", "labels", "_key")
+
+    def __init__(self, registry: "Registry", name: str, labels: dict):
+        self._registry = registry
+        self.name = name
+        self.labels = dict(labels)
+        self._key = (name, _labels_key(labels))
+
+    def set(self, value: float) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        reg._gauges[self._key] = value
+
+
+class Histogram:
+    __slots__ = ("_registry", "name", "labels", "_key", "buckets")
+
+    def __init__(self, registry: "Registry", name: str, labels: dict, buckets: tuple):
+        self._registry = registry
+        self.name = name
+        self.labels = dict(labels)
+        self._key = (name, _labels_key(labels))
+        self.buckets = buckets
+
+    def observe(self, value: float) -> None:
+        reg = self._registry
+        if not reg.enabled:
+            return
+        hists = reg._shard().hists
+        cell = hists.get(self._key)
+        if cell is None:
+            cell = hists[self._key] = _HistCell(len(self.buckets))
+        cell.counts[bisect.bisect_left(self.buckets, value)] += 1
+        cell.sum += value
+        cell.count += 1
+        if value > cell.max:
+            cell.max = value
+
+
+def _retire_shard(registry: "Registry", shard: _Shard) -> None:
+    """finalize callback: fold a dead thread's shard into the retired pool
+    so its totals survive (runs on whatever thread drives GC)."""
+    with registry._lock:
+        _merge_counters(registry._retired_counters, shard.counters)
+        _merge_hists(registry._retired_hists, shard.hists)
+
+
+def _merge_counters(into: dict, frm: dict) -> None:
+    for key, v in list(frm.items()):
+        into[key] = into.get(key, 0) + v
+
+
+def _merge_hists(into: dict, frm: dict) -> None:
+    for key, cell in list(frm.items()):
+        tgt = into.get(key)
+        if tgt is None:
+            tgt = into[key] = _HistCell(len(cell.counts) - 1)
+        for i, c in enumerate(cell.counts):
+            tgt.counts[i] += c
+        tgt.sum += cell.sum
+        tgt.count += cell.count
+        if cell.max > tgt.max:
+            tgt.max = cell.max
+
+
+class Registry:
+    def __init__(self, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get("SDA_TELEMETRY", "1") != "0"
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._live_shards: "weakref.WeakSet[_Shard]" = weakref.WeakSet()
+        self._retired_counters: dict = {}
+        self._retired_hists: dict = {}
+        self._gauges: dict = {}
+        #: metric metadata: name -> (kind, buckets|None, help); registered at
+        #: handle creation so the exposition can emit TYPE lines for series
+        #: that exist but have no samples yet
+        self._meta: dict = {}
+        self._handles: dict = {}
+
+    # -- shard lifecycle -----------------------------------------------------
+
+    def _shard(self) -> _Shard:
+        holder = getattr(self._local, "holder", None)
+        if holder is None:
+            shard = _Shard()
+            holder = _ShardHolder(shard)
+            weakref.finalize(holder, _retire_shard, self, shard)
+            self._local.holder = holder
+            with self._lock:
+                self._live_shards.add(shard)
+        return holder.shard
+
+    # -- handle factories ----------------------------------------------------
+
+    def _handle(self, kind: str, cls, name: str, labels: dict, buckets=None, help=""):
+        key = (kind, name, _labels_key(labels))
+        handle = self._handles.get(key)
+        if handle is None:
+            with self._lock:
+                handle = self._handles.get(key)
+                if handle is None:
+                    prior = self._meta.get(name)
+                    if prior is not None and prior[0] != kind:
+                        raise ValueError(
+                            f"metric {name} already registered as {prior[0]}"
+                        )
+                    self._meta[name] = (kind, buckets, help or (prior[2] if prior else ""))
+                    args = (self, name, labels) if buckets is None else (
+                        self, name, labels, buckets
+                    )
+                    handle = cls(*args)
+                    self._handles[key] = handle
+        return handle
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._handle("counter", Counter, name, labels, help=help)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._handle("gauge", Gauge, name, labels, help=help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets: tuple = DEFAULT_BUCKETS, **labels
+    ) -> Histogram:
+        return self._handle(
+            "histogram", Histogram, name, labels, buckets=tuple(buckets), help=help
+        )
+
+    # -- read side -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Merged view of every shard + the retired pool.
+
+        Returns ``{"counters": {key: int}, "gauges": {key: float},
+        "histograms": {key: {buckets, counts, sum, count, max}},
+        "meta": {name: (kind, buckets, help)}}`` with
+        ``key = (name, ((label, value), ...))``. Totals are exact for all
+        work that happened-before the call (in-flight increments on other
+        threads may or may not be visible — the usual counter contract)."""
+        counters: dict = {}
+        hists: dict = {}
+        with self._lock:
+            _merge_counters(counters, self._retired_counters)
+            _merge_hists(hists, self._retired_hists)
+            for shard in list(self._live_shards):
+                _merge_counters(counters, shard.counters)
+                _merge_hists(hists, shard.hists)
+            gauges = dict(self._gauges)
+            meta = dict(self._meta)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {
+                key: {
+                    "buckets": self._buckets_of(key[0], meta),
+                    "counts": list(cell.counts),
+                    "sum": cell.sum,
+                    "count": cell.count,
+                    "max": cell.max,
+                }
+                for key, cell in hists.items()
+            },
+            "meta": meta,
+        }
+
+    @staticmethod
+    def _buckets_of(name: str, meta: dict):
+        entry = meta.get(name)
+        return list(entry[1]) if entry and entry[1] else list(DEFAULT_BUCKETS)
+
+    def reset(self) -> None:
+        """Clear every series (tests/bench reruns). Live shards are wiped
+        in place; handles and metadata survive so held references stay
+        valid."""
+        with self._lock:
+            self._retired_counters.clear()
+            self._retired_hists.clear()
+            self._gauges.clear()
+            for shard in list(self._live_shards):
+                shard.counters.clear()
+                shard.hists.clear()

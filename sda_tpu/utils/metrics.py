@@ -1,64 +1,103 @@
-"""Phase metrics and tracing.
+"""Legacy phase-metrics facade over the telemetry plane.
 
-The reference has no timers or counters anywhere (SURVEY.md §5); this is a
-from-scratch aux subsystem: lightweight wall-clock phase timers + counters
-with a process-global registry, used by the server snapshot pipeline, the
-clerk hot path, reveal, and the bench harness. ``jax_trace`` wraps the JAX
-profiler for device-level traces.
+The original from-scratch aux subsystem (the reference has no timers or
+counters anywhere, SURVEY.md §5) kept its own locked dicts; it is now an
+adapter over :mod:`sda_tpu.telemetry` so the snapshot pipeline and clerk
+hot path feed the same registry everything else samples:
 
-Exposed over REST as ``GET /v1/metrics`` (an additive route — the reference
-wire protocol is untouched otherwise).
+- ``count(name)``  -> ``sda_events_total{event=name}``
+- ``phase(name)``  -> ``sda_phase_seconds{phase=name}`` plus a
+  ``phase.<name>`` span, so legacy timers join trace-id correlation.
+
+``report()`` keeps the historical shape (``counters`` + ``phases`` with
+count/total/mean/max) and ``reset()`` keeps its windowing semantics by
+baseline subtraction — it never wipes the process registry out from
+under other consumers. One caveat survives the adaptation: ``max_s`` is
+the max since process start, not since ``reset()`` (histogram cells keep
+a running max, not a window). ``jax_trace`` wraps the JAX profiler for
+device-level traces, as before.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
+
+from .. import telemetry
+
+_EVENTS = "sda_events_total"
+_PHASES = "sda_phase_seconds"
+
+
+def _collect() -> tuple:
+    """(counters by event, phases by name -> (count, total_s, max_s))
+    from the current registry snapshot."""
+    snap = telemetry.get_registry().snapshot()
+    counters = {
+        dict(labels)["event"]: value
+        for (name, labels), value in snap["counters"].items()
+        if name == _EVENTS
+    }
+    phases = {
+        dict(labels)["phase"]: (hist["count"], hist["sum"], hist["max"])
+        for (name, labels), hist in snap["histograms"].items()
+        if name == _PHASES
+    }
+    return counters, phases
 
 
 class Metrics:
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: dict = {}
-        self._timers: dict = {}  # name -> [count, total_s, max_s]
+        # report() windows: totals at the last reset(), subtracted out
+        self._base_counters: dict = {}
+        self._base_phases: dict = {}
 
     def count(self, name: str, delta: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + delta
+        telemetry.counter(_EVENTS, "legacy Metrics.count events", event=name).inc(
+            delta
+        )
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        hist = telemetry.histogram(
+            _PHASES, "legacy Metrics.phase timers", phase=name
+        )
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                entry = self._timers.setdefault(name, [0, 0.0, 0.0])
-                entry[0] += 1
-                entry[1] += dt
-                entry[2] = max(entry[2], dt)
+        with telemetry.span(f"phase.{name}"):
+            try:
+                yield
+            finally:
+                # observed even when the phase body raises (legacy semantics)
+                hist.observe(time.perf_counter() - t0)
 
     def report(self) -> dict:
-        with self._lock:
-            return {
-                "counters": dict(self._counters),
-                "phases": {
-                    name: {
-                        "count": c,
-                        "total_s": round(total, 6),
-                        "mean_s": round(total / c, 6) if c else 0.0,
-                        "max_s": round(mx, 6),
-                    }
-                    for name, (c, total, mx) in self._timers.items()
-                },
+        counters, phases = _collect()
+        out_counters = {}
+        for name, value in counters.items():
+            windowed = value - self._base_counters.get(name, 0)
+            if windowed:
+                out_counters[name] = windowed
+        out_phases = {}
+        for name, (count, total, mx) in phases.items():
+            base_count, base_total = self._base_phases.get(name, (0, 0.0))
+            c = count - base_count
+            if not c:
+                continue
+            total = total - base_total
+            out_phases[name] = {
+                "count": c,
+                "total_s": round(total, 6),
+                "mean_s": round(total / c, 6),
+                "max_s": round(mx, 6),
             }
+        return {"counters": out_counters, "phases": out_phases}
 
     def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-            self._timers.clear()
+        counters, phases = _collect()
+        self._base_counters = counters
+        self._base_phases = {
+            name: (count, total) for name, (count, total, _) in phases.items()
+        }
 
 
 _GLOBAL = Metrics()

@@ -84,19 +84,17 @@ def test_full_loop_over_http(http_ctx):
 
 
 def test_metrics_route(http_ctx):
+    """/v1/metrics is unauthenticated Prometheus text, like /v1/ping
+    (full exposition-grammar and series coverage: tests/test_telemetry.py)."""
     _, base_url, tmp_path = http_ctx
-    service = SdaHttpClient(base_url, TokenStore(tmp_path / "m"))
-    alice = new_client(tmp_path / "alice-m", service)
-    alice.upload_agent()
-    resp = requests.get(
-        f"{base_url}/v1/metrics",
-        auth=(str(alice.agent.id), TokenStore(tmp_path / "m").get()),
-    )
+    requests.get(f"{base_url}/v1/ping")
+    resp = requests.get(f"{base_url}/v1/metrics")
     assert resp.status_code == 200
-    body = resp.json()
-    assert "counters" in body and "phases" in body
-    # unauthenticated -> 401
-    assert requests.get(f"{base_url}/v1/metrics").status_code == 401
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    assert "sda_http_requests_total" in resp.text
+    # the JSON snapshot twin serves the same registry
+    snap = requests.get(f"{base_url}/v1/metrics.json").json()
+    assert {"counters", "gauges", "histograms"} <= set(snap)
 
 
 def test_auth_and_error_mapping(http_ctx):

@@ -66,6 +66,30 @@ def test_main_recommends_full_and_overall(tmp_path, capsys):
     assert "fastest overall:           ('rbg', None, 'off')" in out
 
 
+def test_ingest_rider_section(tmp_path, capsys):
+    _write(tmp_path, "ingest-20260805-010000.json",
+           {"metric": "batched_participation_ingest",
+            "seal_batch_per_s": 40000, "build_per_s": 800,
+            "participate_many_per_s": 900, "rest_sqlite_batch_per_s": 8000,
+            "rest_mem_batch_per_s": 10000, "telemetry_overhead_pct": 1.2})
+    _write(tmp_path, "ingest-old-20260731.json",
+           {"seal_batch_per_s": 12000})  # pre-telemetry artifact: kept, gaps dashed
+    _write(tmp_path, "ingest-broken.json", {"note": "no rates"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # ingest rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "batched-ingest riders" in out
+    assert "ingest-20260805-010000.json" in out
+    assert "ingest-old-20260731.json" in out
+    assert "ingest-broken.json" not in out
+    assert "fastest" not in out  # no exp rows -> no device recommendation
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]
