@@ -12,7 +12,8 @@ Prometheus scraper would and checks:
 1. every line obeys the text-format 0.0.4 line grammar;
 2. the core series exist with nonzero samples:
    sda_http_requests_total, sda_store_op_seconds, sda_crypto_seals_total,
-   sda_engine_step_seconds.
+   sda_engine_step_seconds, and — via a paged clerking round —
+   sda_clerk_stage_seconds and sda_clerk_overlap_efficiency.
 
 Run by ci.sh after the CLI walkthrough: JAX_PLATFORMS=cpu python
 scripts/check_metrics.py. Exit 0 on pass, 1 with a diagnostic on fail.
@@ -45,6 +46,10 @@ REQUIRED_SERIES = [
     "sda_store_op_seconds",
     "sda_crypto_seals_total",
     "sda_engine_step_seconds",
+    # clerking pipeline: stage histograms + the overlap gauge, lit by the
+    # paged-job round drive_workload runs (threshold 0 pages every job)
+    "sda_clerk_stage_seconds",
+    "sda_clerk_overlap_efficiency",
 ]
 
 
@@ -95,6 +100,20 @@ def drive_workload(base_url: str, tmp: str) -> None:
     participant = new_client("participant")
     participant.upload_agent()
     participant.participate([1, 2, 3, 4], agg.id)  # seals -> crypto series
+
+    # run the round to completion through the PAGED delivery path so the
+    # clerk pipeline series (download/decrypt/combine histograms + the
+    # overlap-efficiency gauge) appear in the scrape
+    os.environ["SDA_JOB_PAGE_THRESHOLD"] = "0"
+    os.environ["SDA_JOB_CHUNK_SIZE"] = "2"
+    try:
+        recipient.end_aggregation(agg.id)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+        recipient.run_chores(-1)  # recipient may hold a committee seat too
+    finally:
+        os.environ.pop("SDA_JOB_PAGE_THRESHOLD", None)
+        os.environ.pop("SDA_JOB_CHUNK_SIZE", None)
 
 
 def drive_engine() -> None:

@@ -13,6 +13,13 @@ measure_batched_ingest): host sealing, client build, and REST ingest
 rates plus the measured telemetry overhead, one row per run — the
 host-plane trend line next to the device-plane sweep table.
 
+Also tabulates the clerking-pipeline rider artifacts
+(``bench-artifacts/clerking-<stamp>.json``, written by bench.py's
+measure_clerking_pipeline): one row per delivery config (monolithic
+baseline + each paged chunk size) with throughput, the ratio against the
+monolithic baseline from the SAME run, peak clerk RSS, and the measured
+download-overlap efficiency.
+
 Usage: python scripts/sweep_report.py [artifact_dir]
 """
 
@@ -96,6 +103,54 @@ def print_ingest(rows) -> None:
         print(f"{row}  {r['artifact']}")
 
 
+def load_clerking(artdir: pathlib.Path):
+    """One row per delivery config per clerking-*.json artifact."""
+    rows = []
+    for f in sorted(artdir.glob("clerking-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        configs = d.get("configs") if isinstance(d, dict) else None
+        if not isinstance(configs, dict):
+            continue
+        n = (d.get("config") or {}).get("n_participants")
+        for tag, cfg in sorted(configs.items()):
+            if not isinstance(cfg, dict) or cfg.get("encryptions_per_s") is None:
+                continue
+            rows.append(
+                {
+                    "artifact": f.name,
+                    "tag": tag,
+                    "n": n,
+                    "chunk": cfg.get("chunk_size"),
+                    "encs_per_s": cfg.get("encryptions_per_s"),
+                    "vs_mono": cfg.get("vs_monolithic"),
+                    "rss_mib": cfg.get("peak_rss_mib"),
+                    "overlap": cfg.get("overlap_efficiency"),
+                }
+            )
+    return rows
+
+
+def print_clerking(rows) -> None:
+    print("\nclerking-pipeline riders (clerking-*.json):")
+    print(
+        f"{'config':>14} {'n':>7} {'chunk':>6} {'encs/s':>9} {'vs_mono':>8} "
+        f"{'rss_mib':>8} {'overlap':>8}  artifact"
+    )
+    for r in rows:
+        overlap = f"{r['overlap']:.2f}" if r["overlap"] is not None else "-"
+        print(
+            f"{r['tag']:>14} {r['n'] if r['n'] is not None else '-':>7} "
+            f"{r['chunk'] if r['chunk'] is not None else '-':>6} "
+            f"{r['encs_per_s']:>9} "
+            f"{r['vs_mono'] if r['vs_mono'] is not None else '-':>8} "
+            f"{r['rss_mib'] if r['rss_mib'] is not None else '-':>8} "
+            f"{overlap:>8}  {r['artifact']}"
+        )
+
+
 def tag_of(row):
     # prefer the metric line (bench.py records rng/chunk/check since r5,
     # ADVICE r4 #2); filename tag as fallback for pre-r5 artifacts
@@ -124,9 +179,11 @@ def main() -> int:
     artdir = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "bench-artifacts")
     rows = load(artdir)
     ingest_rows = load_ingest(artdir)
-    if not rows and not ingest_rows:
+    clerking_rows = load_clerking(artdir)
+    if not rows and not ingest_rows and not clerking_rows:
         print(
-            f"no rate-bearing exp-*.json or ingest-*.json artifacts under {artdir}/",
+            f"no rate-bearing exp-*.json, ingest-*.json, or clerking-*.json "
+            f"artifacts under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -163,6 +220,8 @@ def main() -> int:
 
     if ingest_rows:
         print_ingest(ingest_rows)
+    if clerking_rows:
+        print_clerking(clerking_rows)
     return 0
 
 

@@ -7,20 +7,39 @@ folding each chunk's partial into a running modular sum: vectorized like
 one big reduction, but peak memory is one chunk of plaintext vectors —
 the accumulating combiner the reference suggests for itself at
 clerk.rs:71-73.
+
+Large jobs arrive PAGED: the server returns metadata only
+(``total_encryptions`` + suggested ``chunk_size``) and the clerk pulls
+the ciphertext column range-by-range via ``get_clerking_job_chunk``.
+Download and compute overlap in a two-stage pipeline — a prefetch thread
+fetches chunk i+1 while the main thread decrypts + folds chunk i — so
+wall time approaches max(download, decrypt+combine) instead of their
+sum, with at most two chunks resident at once.
 """
 
 from __future__ import annotations
 
+import threading
+import time
+
+from .. import telemetry
 from ..ops.modular import positive
-from ..protocol import PackedPaillierEncryptionScheme, ClerkingResult
+from ..protocol import PackedPaillierEncryptionScheme, ClerkingResult, SdaError
 from .keys import VerifiedKeys
 from ..utils.metrics import get_metrics
+
+#: pipeline stage latency — one histogram per stage; the bench rider and
+#: scripts/check_metrics.py key on this series name
+_STAGE_SERIES = "sda_clerk_stage_seconds"
+_STAGE_HELP = "clerk job pipeline stage latency by stage"
 
 
 class Clerking(VerifiedKeys):
     #: participants decrypted + folded per block in process_clerking_job;
-    #: bounds clerk memory to one block of plaintext share vectors
+    #: bounds clerk memory to one block of plaintext share vectors (and is
+    #: the fallback chunk length when a paged job suggests none)
     DECRYPT_CHUNK = 4096
+
     def clerk_once(self) -> bool:
         """Process the next pending job, if any; returns whether one ran."""
         job = self.service.get_clerking_job(self.agent, self.agent.id)
@@ -40,6 +59,77 @@ class Clerking(VerifiedKeys):
                 if not self.clerk_once():
                     break
 
+    def _iter_job_chunks(self, job, stage_times: dict):
+        """Yield the job's ciphertext column as decrypt-ready blocks.
+
+        Monolithic jobs slice the in-memory column by ``DECRYPT_CHUNK``.
+        Paged jobs (``is_paged()`` — column left server-side) run the
+        download stage of the pipeline: chunk 0 is fetched synchronously,
+        then a prefetch thread downloads chunk i+1 while the consumer
+        decrypts chunk i. In-flight memory is bounded to two chunks: the
+        one being decrypted and the one being prefetched. The range
+        cursor advances by the length the server actually returned, so a
+        server configured with a different chunk size stays in lockstep.
+        """
+        if not job.is_paged():
+            for start in range(0, len(job.encryptions), self.DECRYPT_CHUNK):
+                yield job.encryptions[start : start + self.DECRYPT_CHUNK]
+            return
+
+        total = job.total_encryptions
+        if total <= 0:
+            return
+
+        download_hist = telemetry.histogram(
+            _STAGE_SERIES, _STAGE_HELP, stage="download"
+        )
+
+        def fetch(start: int):
+            t0 = time.perf_counter()
+            chunk = self.service.get_clerking_job_chunk(self.agent, job.id, start)
+            dt = time.perf_counter() - t0
+            download_hist.observe(dt)
+            stage_times["download"] += dt
+            if chunk is None:
+                raise SdaError(f"clerking job {job.id} disappeared mid-download")
+            if not chunk:
+                raise SdaError(
+                    f"clerking job {job.id} column truncated at {start}/{total}"
+                )
+            return chunk
+
+        # the prefetch worker starts with a fresh contextvars context —
+        # rebind the caller's trace id so chunk GETs still carry
+        # X-SDA-Trace (same idiom as participate_many's upload thread)
+        trace_id = telemetry.current_trace_id()
+
+        def prefetch(start: int, box: list) -> None:
+            if trace_id:
+                telemetry.set_trace_id(trace_id)
+            try:
+                box.append(fetch(start))
+            except BaseException as exc:  # re-raised on the consumer side
+                box.append(exc)
+
+        chunk = fetch(0)
+        start = len(chunk)
+        while True:
+            worker = None
+            box: list = []
+            if start < total:
+                worker = threading.Thread(
+                    target=prefetch, args=(start, box), daemon=True
+                )
+                worker.start()
+            yield chunk
+            if worker is None:
+                return
+            worker.join()
+            if isinstance(box[0], BaseException):
+                raise box[0]
+            chunk = box[0]
+            start += len(chunk)
+
     def process_clerking_job(self, job) -> ClerkingResult:
         aggregation = self.service.get_aggregation(self.agent, job.aggregation)
         if aggregation is None:
@@ -56,12 +146,18 @@ class Clerking(VerifiedKeys):
         if own_key_id is None:
             raise ValueError("Could not find own encryption key in keyset")
 
+        total = (
+            job.total_encryptions if job.is_paged() else len(job.encryptions)
+        )
         metrics = get_metrics()
         metrics.count("clerk.jobs")
-        metrics.count("clerk.participations", len(job.encryptions))
+        metrics.count("clerk.participations", total)
         decryptor = self.crypto.new_share_decryptor(
             own_key_id, aggregation.committee_encryption_scheme
         )
+        decrypt_hist = telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="decrypt")
+        combine_hist = telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="combine")
+        stage_times = {"download": 0.0, "decrypt": 0.0, "combine": 0.0}
         # decrypt + combine in chunks: the reference materializes every
         # participant's share vector before summing and flags it as a
         # known inefficiency (clerk.rs:71-73, "accumulating combiner
@@ -72,10 +168,15 @@ class Clerking(VerifiedKeys):
         # mod p and the reveal lifts via positive(), so results match).
         combiner = self.crypto.new_share_combiner(aggregation.committee_sharing_scheme)
         combined = None
-        for start in range(0, len(job.encryptions), self.DECRYPT_CHUNK):
-            block = job.encryptions[start : start + self.DECRYPT_CHUNK]
+        t_wall0 = time.perf_counter()
+        for block in self._iter_job_chunks(job, stage_times):
+            t0 = time.perf_counter()
             with metrics.phase("clerk.decrypt"):
                 share_vectors = decryptor.decrypt_batch(block)
+            dt = time.perf_counter() - t0
+            decrypt_hist.observe(dt)
+            stage_times["decrypt"] += dt
+            t0 = time.perf_counter()
             with metrics.phase("clerk.combine"):
                 partial = combiner.combine(share_vectors)
                 combined = (
@@ -83,6 +184,24 @@ class Clerking(VerifiedKeys):
                     if combined is None
                     else combiner.combine([combined, partial])
                 )
+            dt = time.perf_counter() - t0
+            combine_hist.observe(dt)
+            stage_times["combine"] += dt
+        t_wall = time.perf_counter() - t_wall0
+        if stage_times["download"] > 0:
+            # how much of the download cost the pipeline hid behind
+            # compute: 1.0 = fully overlapped, 0.0 = fully serial
+            overlap = (
+                stage_times["download"]
+                + stage_times["decrypt"]
+                + stage_times["combine"]
+                - t_wall
+            ) / stage_times["download"]
+            telemetry.gauge(
+                "sda_clerk_overlap_efficiency",
+                "fraction of download time hidden behind decrypt+combine "
+                "by the paged-job pipeline (last job)",
+            ).set(min(1.0, max(0.0, overlap)))
         if combined is None:  # empty snapshot cut
             combined = combiner.combine([])
         if isinstance(

@@ -42,6 +42,10 @@ extern int crypto_generichash(unsigned char *out, size_t outlen,
 extern int crypto_box_easy_afternm(unsigned char *c, const unsigned char *m,
                                    unsigned long long mlen, const unsigned char *n,
                                    const unsigned char *k);
+extern int crypto_box_open_easy_afternm(unsigned char *m, const unsigned char *c,
+                                        unsigned long long clen,
+                                        const unsigned char *n,
+                                        const unsigned char *k);
 extern int crypto_stream_chacha20_xor_ic(unsigned char *c, const unsigned char *m,
                                          unsigned long long mlen,
                                          const unsigned char *n, uint64_t ic,
@@ -222,6 +226,61 @@ static Py_ssize_t comb_seal_range(const comb_table *pt, const unsigned char *pk,
     return i < n ? lo + i : -1;
 }
 
+/* Open ins[lo..hi) addressed to (pk, sk), batching the expensive X25519
+ * work: one variable-base ladder per ciphertext (independent ephemeral
+ * keys — nothing to share), but the per-item projective division is
+ * deferred into ONE Montgomery batch inversion for the whole chunk, and
+ * the nonce-hash input's recipient half is hoisted out of the loop.  The
+ * symmetric open is libsodium's own afternm primitive, so acceptance is
+ * bit-for-bit crypto_box_seal_open.  Returns -1 on success or the lowest
+ * failing index (zero shared secret and MAC failure both count, exactly
+ * the cases crypto_box_seal_open rejects). */
+static Py_ssize_t open_range(const unsigned char *pk, const unsigned char *sk,
+                             const unsigned char **ins, const Py_ssize_t *inlens,
+                             unsigned char **outs, Py_ssize_t lo, Py_ssize_t hi) {
+    Py_ssize_t n = hi - lo, i;
+    fe *num, *den, *scr;
+    unsigned char *us;
+    unsigned char hin[64];
+    if (n <= 0) return -1;
+    num = malloc(sizeof(fe) * (size_t)n);
+    den = malloc(sizeof(fe) * (size_t)n);
+    scr = malloc(sizeof(fe) * (size_t)n);
+    us = malloc((size_t)n * 32);
+    if (!num || !den || !scr || !us) {
+        /* allocation pressure: the slow, allocation-free thing */
+        free(num); free(den); free(scr); free(us);
+        for (i = lo; i < hi; i++)
+            if (crypto_box_seal_open(outs[i], ins[i],
+                                     (unsigned long long)inlens[i], pk, sk) != 0)
+                return i;
+        return -1;
+    }
+    for (i = 0; i < n; i++) /* epk is the sealed box's first 32 bytes */
+        sda_x25519_ladder_frac(&num[i], &den[i], sk, ins[lo + i]);
+    sda_comb_finalize_u(us, num, den, scr, (int)n);
+    memcpy(hin + 32, pk, 32); /* fixed for the chunk */
+    for (i = 0; i < n; i++) {
+        const unsigned char *shared = us + i * 32;
+        unsigned char k[32], nonce[crypto_box_NONCEBYTES];
+        static const unsigned char zero16[16] = {0};
+        if (is_zero32(shared)) break; /* crypto_box_beforenm failure */
+        crypto_core_hsalsa20(k, zero16, shared, NULL);
+        memcpy(hin, ins[lo + i], 32);
+        crypto_generichash(nonce, sizeof nonce, hin, sizeof hin, NULL, 0);
+        if (crypto_box_open_easy_afternm(outs[lo + i], ins[lo + i] + 32,
+                                         (unsigned long long)(inlens[lo + i] - 32),
+                                         nonce, k) != 0) {
+            sodium_memzero(k, sizeof k);
+            break;
+        }
+        sodium_memzero(k, sizeof k);
+    }
+    sodium_memzero(us, (size_t)n * 32);
+    free(num); free(den); free(scr); free(us);
+    return i < n ? lo + i : -1;
+}
+
 typedef struct {
     Py_ssize_t lo, hi;
     const unsigned char **ins;
@@ -229,11 +288,17 @@ typedef struct {
     unsigned char **outs;
     const unsigned char *pk, *sk; /* sk NULL => seal, else open */
     const comb_table *pt;         /* non-NULL => comb seal path */
+    int batch_open;               /* non-zero => deferred-inversion open path */
     Py_ssize_t fail;              /* lowest failing index in chunk, or -1 */
 } sealjob_t;
 
 static void *seal_open_worker(void *arg) {
     sealjob_t *j = (sealjob_t *)arg;
+    if (j->sk && j->batch_open) {
+        j->fail = open_range(j->pk, j->sk, j->ins, j->inlens, j->outs,
+                             j->lo, j->hi);
+        return NULL;
+    }
     if (j->pt && !j->sk) {
         j->fail = comb_seal_range(j->pt, j->pk, j->ins, j->inlens, j->outs,
                                   j->lo, j->hi);
@@ -312,6 +377,9 @@ static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
     {
         Py_ssize_t first_fail = -1;
         comb_table *pt = NULL;
+        /* deferred-inversion open pays one batch inversion per chunk;
+         * below the min batch the setup outweighs the saving */
+        int batch_open = (sk != NULL && n >= SDA_COMB_MIN_BATCH);
         if (!sk && n >= SDA_COMB_MIN_BATCH) {
             pt = PyMem_Malloc(sizeof(comb_table));
             if (pt) {
@@ -327,7 +395,7 @@ static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
         }
         Py_BEGIN_ALLOW_THREADS
         if (n_threads <= 1) {
-            sealjob_t job = {0, n, ins, inlens, outs, pk, sk, pt, -1};
+            sealjob_t job = {0, n, ins, inlens, outs, pk, sk, pt, batch_open, -1};
             seal_open_worker(&job);
             first_fail = job.fail;
         } else {
@@ -338,7 +406,8 @@ static PyObject *seal_open_batch(PyObject *items, const unsigned char *pk,
             for (long t = 0; t < n_threads; t++) {
                 Py_ssize_t lo = t * chunk;
                 Py_ssize_t hi = lo + chunk < n ? lo + chunk : n;
-                sealjob_t j = {lo, hi, ins, inlens, outs, pk, sk, pt, -1};
+                sealjob_t j = {lo, hi, ins, inlens, outs, pk, sk, pt,
+                               batch_open, -1};
                 jobs[t] = j;
                 started[t] =
                     pthread_create(&tids[t], NULL, seal_open_worker, &jobs[t]) == 0;

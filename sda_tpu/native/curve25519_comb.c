@@ -76,6 +76,34 @@ static void fe_cmov(fe *f, const fe *g, uint64_t mask)
     for (i = 0; i < 5; i++) f->v[i] = (f->v[i] & ~mask) | (g->v[i] & mask);
 }
 
+/* branch-free swap of f and g when swap == 1 (must be 0 or 1) */
+static void fe_cswap(fe *f, fe *g, uint64_t swap)
+{
+    uint64_t mask = (uint64_t)0 - swap;
+    int i;
+    for (i = 0; i < 5; i++) {
+        uint64_t x = (f->v[i] ^ g->v[i]) & mask;
+        f->v[i] ^= x;
+        g->v[i] ^= x;
+    }
+}
+
+/* h = 121666 * f, carried.  Inputs may carry the 4p-biased magnitudes the
+ * sub/add formulas produce (limbs < 2^54): 2^54 * 121666 < 2^71 per limb
+ * fits __uint128_t with room to spare. */
+static void fe_mul121666(fe *h, const fe *f)
+{
+    __uint128_t r;
+    uint64_t c, h0, h1, h2, h3, h4;
+    r = (__uint128_t)f->v[0] * 121666;     h0 = (uint64_t)r & MASK51; c = (uint64_t)(r >> 51);
+    r = (__uint128_t)f->v[1] * 121666 + c; h1 = (uint64_t)r & MASK51; c = (uint64_t)(r >> 51);
+    r = (__uint128_t)f->v[2] * 121666 + c; h2 = (uint64_t)r & MASK51; c = (uint64_t)(r >> 51);
+    r = (__uint128_t)f->v[3] * 121666 + c; h3 = (uint64_t)r & MASK51; c = (uint64_t)(r >> 51);
+    r = (__uint128_t)f->v[4] * 121666 + c; h4 = (uint64_t)r & MASK51; c = (uint64_t)(r >> 51);
+    h0 += 19 * c;
+    h->v[0] = h0; h->v[1] = h1; h->v[2] = h2; h->v[3] = h3; h->v[4] = h4;
+}
+
 static void fe_mul(fe *h, const fe *f, const fe *g)
 {
     uint64_t f0 = f->v[0], f1 = f->v[1], f2 = f->v[2], f3 = f->v[3], f4 = f->v[4];
@@ -488,6 +516,69 @@ void sda_comb_finalize_u(unsigned char *out /* n*32 */, fe *num, fe *den,
         fe_mul(&u, &num[i], &dinv);
         fe_tobytes(out + 32 * (size_t)i, &u);
     }
+}
+
+/* ---- Montgomery ladder with deferred inversion ----
+ *
+ * The comb tables above only help FIXED-base scalarmults.  Opening a
+ * batch of sealed boxes is the opposite shape: every ciphertext carries a
+ * DIFFERENT ephemeral public key, and the recipient computes sk * epk_i —
+ * a variable-base scalarmult per item that no table can amortize.  What
+ * CAN be amortized is the final projective-to-affine division: the ladder
+ * ends with u = X2/Z2, and libsodium pays a full field inversion (~254
+ * squarings) per call.  This variant returns the (X2, Z2) fraction so the
+ * caller batch-inverts across the whole chunk via sda_comb_finalize_u —
+ * one inversion total instead of one per ciphertext.
+ *
+ * Standard RFC 7748 ladder, ref10 operation ordering (the z2 term uses
+ * the BB + 121666*E form, equal to AA + 121665*E since AA = BB + E).
+ * The scalar is clamped here exactly as crypto_scalarmult does, so a
+ * zero output fraction reproduces libsodium's all-zero shared secret for
+ * small-order points (callers treat it as an open failure, matching
+ * crypto_box_beforenm).  Constant-time: bit-masked cswap, no
+ * secret-dependent branches or loads. */
+void sda_x25519_ladder_frac(fe *xout, fe *zout, const unsigned char scalar[32],
+                            const unsigned char point[32])
+{
+    unsigned char e[32];
+    fe x1, x2, z2, x3, z3, tmp0, tmp1;
+    int pos;
+    uint64_t swap = 0, b;
+
+    memcpy(e, scalar, 32);
+    e[0] &= 248; e[31] &= 127; e[31] |= 64; /* X25519 clamp */
+    fe_frombytes(&x1, point);
+    fe_1(&x2); fe_0(&z2);
+    x3 = x1;   fe_1(&z3);
+    for (pos = 254; pos >= 0; --pos) {
+        b = (uint64_t)(e[pos / 8] >> (pos & 7)) & 1;
+        swap ^= b;
+        fe_cswap(&x2, &x3, swap);
+        fe_cswap(&z2, &z3, swap);
+        swap = b;
+        fe_sub(&tmp0, &x3, &z3);
+        fe_sub(&tmp1, &x2, &z2);
+        fe_add(&x2, &x2, &z2);
+        fe_add(&z2, &x3, &z3);
+        fe_mul(&z3, &tmp0, &x2);
+        fe_mul(&z2, &z2, &tmp1);
+        fe_sq(&tmp0, &tmp1);
+        fe_sq(&tmp1, &x2);
+        fe_add(&x3, &z3, &z2);
+        fe_sub(&z2, &z3, &z2);
+        fe_mul(&x2, &tmp1, &tmp0);
+        fe_sub(&tmp1, &tmp1, &tmp0);
+        fe_sq(&z2, &z2);
+        fe_mul121666(&z3, &tmp1);
+        fe_sq(&x3, &x3);
+        fe_add(&tmp0, &tmp0, &z3);
+        fe_mul(&z3, &x1, &z2);
+        fe_mul(&z2, &tmp1, &tmp0);
+    }
+    fe_cswap(&x2, &x3, swap);
+    fe_cswap(&z2, &z3, swap);
+    *xout = x2;
+    *zout = z2;
 }
 
 /* single-shot u-coordinate scalarmult (tests + small batches) */

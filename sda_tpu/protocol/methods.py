@@ -86,7 +86,25 @@ class SdaService(abc.ABC):
 
     @abc.abstractmethod
     def get_clerking_job(self, caller, clerk_id):
-        """Poll the durable queue for the clerk's next job, if any."""
+        """Poll the durable queue for the clerk's next job, if any.
+
+        Jobs above the server's paging threshold come back as metadata
+        (``ClerkingJob.is_paged()``): ``encryptions`` empty,
+        ``total_encryptions``/``chunk_size`` set, the ciphertext column
+        fetched range-by-range via ``get_clerking_job_chunk``."""
+
+    def get_clerking_job_chunk(self, caller, job_id, start: int):
+        """Fetch one ciphertext range ``[start, start+server_chunk)`` of
+        a paged clerking job the caller owns; returns list[Encryption]
+        (empty past the end), or None for a job that doesn't exist or
+        belongs to another clerk. Bindings serve this from the chunk
+        route / ranged store reads; this default exists so third-party
+        ``SdaService`` implementations predating paged delivery keep
+        importing — but they will never hand out a paged job either, so
+        reaching it means a binding/version mismatch."""
+        raise NotImplementedError(
+            "this SdaService binding does not support paged clerking jobs"
+        )
 
     @abc.abstractmethod
     def create_clerking_result(self, caller, result) -> None:

@@ -247,22 +247,41 @@ class Snapshot:
 
 @dataclass
 class ClerkingJob:
-    """Partial aggregation job for one clerk (resources.rs:128-139)."""
+    """Partial aggregation job for one clerk (resources.rs:128-139).
+
+    Jobs above the server's paging threshold are DELIVERED as metadata:
+    ``encryptions`` empty, ``total_encryptions``/``chunk_size`` set, and
+    the ciphertext column fetched range-by-range via
+    ``GET /v1/aggregations/implied/jobs/{id}/chunks/{start}``. Small jobs
+    keep the original five-key wire shape (both paging fields are emitted
+    only when set), so pre-paging clients and transcripts stay byte
+    compatible.
+    """
 
     id: ClerkingJobId
     clerk: AgentId
     aggregation: AggregationId
     snapshot: SnapshotId
     encryptions: list  # list[Encryption], one per participant
+    total_encryptions: Optional[int] = None  # paged delivery only
+    chunk_size: Optional[int] = None  # server's suggested fetch range
+
+    def is_paged(self) -> bool:
+        return self.total_encryptions is not None
 
     def to_json(self):
-        return {
+        obj = {
             "id": self.id.to_json(),
             "clerk": self.clerk.to_json(),
             "aggregation": self.aggregation.to_json(),
             "snapshot": self.snapshot.to_json(),
             "encryptions": [e.to_json() for e in self.encryptions],
         }
+        if self.total_encryptions is not None:
+            obj["total_encryptions"] = self.total_encryptions
+        if self.chunk_size is not None:
+            obj["chunk_size"] = self.chunk_size
+        return obj
 
     @classmethod
     def from_json(cls, obj):
@@ -272,6 +291,8 @@ class ClerkingJob:
             aggregation=AggregationId.from_json(obj["aggregation"]),
             snapshot=SnapshotId.from_json(obj["snapshot"]),
             encryptions=[Encryption.from_json(e) for e in obj["encryptions"]],
+            total_encryptions=_opt(obj.get("total_encryptions"), int),
+            chunk_size=_opt(obj.get("chunk_size"), int),
         )
 
 

@@ -221,6 +221,16 @@ class SdaHttpClient(SdaService):
         obj = self._request("GET", "/v1/aggregations/any/jobs", caller)
         return None if obj is None else ClerkingJob.from_json(obj)
 
+    def get_clerking_job_chunk(self, caller, job_id, start):
+        from ..protocol import Encryption
+
+        obj = self._request(
+            "GET",
+            f"/v1/aggregations/implied/jobs/{quote(str(job_id))}/chunks/{int(start)}",
+            caller,
+        )
+        return None if obj is None else [Encryption.from_json(e) for e in obj]
+
     def create_clerking_result(self, caller, result) -> None:
         self._request(
             "POST",

@@ -22,6 +22,8 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
     GET    /v1/aggregations/{AggregationId}/status
     POST   /v1/aggregations/implied/snapshot
     GET    /v1/aggregations/any/jobs
+    GET    /v1/aggregations/implied/jobs/{ClerkingJobId}/chunks/{start}
+                              (additive; one ciphertext range of a paged job)
     POST   /v1/aggregations/implied/jobs/{ClerkingJobId}/result
     GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result
     GET    /v1/metrics        (additive; unauthenticated Prometheus text)
@@ -64,6 +66,7 @@ from ..protocol import (
     AgentId,
     Aggregation,
     AggregationId,
+    ClerkingJobId,
     ClerkingResult,
     Committee,
     EncryptionKeyId,
@@ -391,6 +394,20 @@ class _Handler(BaseHTTPRequestHandler):
         if method == "GET" and path == "/v1/aggregations/any/jobs":
             caller = self._caller()
             self._send_json_option(svc.get_clerking_job(caller, caller.id))
+            return True
+
+        if method == "GET" and (
+            match := m(rf"/v1/aggregations/implied/jobs/({_UUID})/chunks/(\d+)")
+        ):
+            # one ciphertext range of a paged clerking job; the clerk is
+            # implied by auth (chunk reads answer 404 unless the caller
+            # owns the job). Response: bare JSON array of encryptions.
+            chunk = svc.get_clerking_job_chunk(
+                self._caller(), ClerkingJobId(match.group(1)), int(match.group(2))
+            )
+            self._send_json_option(
+                None if chunk is None else [e.to_json() for e in chunk]
+            )
             return True
 
         if method == "POST" and (match := m(rf"/v1/aggregations/implied/jobs/({_UUID})/result")):

@@ -14,7 +14,9 @@ checkpoint/resume story (SURVEY.md §5) and it is preserved here.
 
 from __future__ import annotations
 
+import json
 import os
+import struct
 
 from ..protocol import (
     Agent,
@@ -23,6 +25,7 @@ from ..protocol import (
     ClerkingResult,
     Committee,
     Aggregation,
+    Encryption,
     InvalidRequestError,
     Labelled,
     Participation,
@@ -37,7 +40,15 @@ from ..protocol.ids import (
     SnapshotId,
 )
 from ..utils.jsondir import ConflictError, JsonDir
-from .stores import AggregationsStore, AgentsStore, AuthTokensStore, ClerkingJobsStore
+from .stores import (
+    AggregationsStore,
+    AgentsStore,
+    AuthTokensStore,
+    ClerkingJobsStore,
+    job_chunk_size,
+    job_page_threshold,
+    split_small_column,
+)
 
 
 def _create(jdir: JsonDir, id, payload) -> None:
@@ -301,6 +312,39 @@ class FileAggregationsStore(AggregationsStore):
 
         return columns()
 
+    def iter_snapshot_clerk_jobs_chunks(
+        self, aggregation_id, snapshot_id, clerks_number: int, chunk_size: int
+    ):
+        """Chunked transpose for large cohorts: each chunk re-reads only
+        its own slice of the frozen member list, so peak memory per clerk
+        is one chunk of ciphertexts instead of one column. Below the
+        threshold the default (re-chunked eager transpose) is cheaper —
+        one file read per participation instead of ``clerks``."""
+        n = self.count_participations_snapshot(aggregation_id, snapshot_id)
+        if n <= self.TRANSPOSE_STREAM_THRESHOLD:
+            return super().iter_snapshot_clerk_jobs_chunks(
+                aggregation_id, snapshot_id, clerks_number, chunk_size
+            )
+        members = self.members.get(snapshot_id) or []
+        table = self._participations(aggregation_id)
+
+        def column_chunks(ix: int):
+            for lo in range(0, len(members), chunk_size):
+                block = []
+                for pid in members[lo : lo + chunk_size]:
+                    payload = table.get(pid)
+                    if payload is None:
+                        raise ServerError(
+                            f"snapshot {snapshot_id}: snapped participation "
+                            f"{pid} has no payload on disk — store corrupted?"
+                        )
+                    block.append(
+                        Participation.from_json(payload).clerk_encryptions[ix][1]
+                    )
+                yield block
+
+        return (column_chunks(ix) for ix in range(clerks_number))
+
     def create_snapshot_mask(self, snapshot_id, mask) -> None:
         self.masks.put(snapshot_id, [e.to_json() for e in mask])
 
@@ -312,6 +356,16 @@ class FileAggregationsStore(AggregationsStore):
 
 
 class FileClerkingJobsStore(ClerkingJobsStore):
+    """Two column layouts, mirroring the sqlite backend:
+
+    - INLINE (legacy / small jobs): the full job JSON in the queue dir.
+    - EXTERNALIZED: the queue JSON is metadata only
+      (``total_encryptions`` set) and the ciphertext column lives in
+      ``columns/<job-id>.jsonl`` (one encryption per line) with a
+      sidecar ``columns/<job-id>.idx`` of n+1 little-endian uint64 byte
+      offsets — a chunk read is two seeks, never a column parse.
+    """
+
     def __init__(self, path):
         self.root = str(path)
 
@@ -324,31 +378,163 @@ class FileClerkingJobsStore(ClerkingJobsStore):
     def _results(self, snapshot_id) -> JsonDir:
         return JsonDir(os.path.join(self.root, "results", str(snapshot_id)))
 
+    def _column_paths(self, job_id):
+        d = os.path.join(self.root, "columns")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{job_id}.jsonl"), os.path.join(d, f"{job_id}.idx")
+
+    def _read_column_range(self, job_id, start: int, end: int) -> list:
+        """Ciphertexts [start, end) via the offset sidecar: seek into the
+        idx for the bounding offsets, then one ranged read of the jsonl."""
+        if end <= start:
+            return []
+        data_path, idx_path = self._column_paths(job_id)
+        with open(idx_path, "rb") as xf:
+            xf.seek(start * 8)
+            raw = xf.read((end - start + 1) * 8)
+        offs = struct.unpack(f"<{len(raw) // 8}Q", raw)
+        if len(offs) < 2:
+            return []
+        with open(data_path, "rb") as df:
+            df.seek(offs[0])
+            blob = df.read(offs[-1] - offs[0])
+        return [Encryption.from_json(json.loads(line)) for line in blob.splitlines()]
+
+    def _deliver(self, payload):
+        """Stored payload -> wire body under the current paging threshold."""
+        job = ClerkingJob.from_json(payload)
+        total = (
+            job.total_encryptions
+            if job.total_encryptions is not None
+            else len(job.encryptions)
+        )
+        if total > job_page_threshold():
+            return ClerkingJob(
+                id=job.id,
+                clerk=job.clerk,
+                aggregation=job.aggregation,
+                snapshot=job.snapshot,
+                encryptions=[],
+                total_encryptions=total,
+                chunk_size=job_chunk_size(),
+            )
+        if job.total_encryptions is None:
+            return job  # inline + small: original shape, untouched
+        # externalized + small: reassemble the monolithic wire body
+        return ClerkingJob(
+            id=job.id,
+            clerk=job.clerk,
+            aggregation=job.aggregation,
+            snapshot=job.snapshot,
+            encryptions=self._read_column_range(job.id, 0, total),
+        )
+
     def enqueue_clerking_job(self, job) -> None:
         # idempotent under snapshot retries (job ids are deterministic): a
         # job already queued or already completed is not enqueued again
+        if len(job.encryptions) > job_page_threshold():
+            self.enqueue_clerking_job_chunked(
+                ClerkingJob(
+                    id=job.id,
+                    clerk=job.clerk,
+                    aggregation=job.aggregation,
+                    snapshot=job.snapshot,
+                    encryptions=[],
+                ),
+                [job.encryptions],
+            )
+            return
         if self._done(job.clerk).get(job.id) is not None:
             return
         _create(self._queue(job.clerk), job.id, job.to_json())
+
+    def enqueue_clerking_job_chunked(self, job, chunks) -> None:
+        """Streaming enqueue into the externalized layout: column ranges
+        append to tmp files (one chunk in memory at a time), both files
+        land atomically via os.replace, and the queue metadata JSON —
+        the job's visibility point — is written last, so a crash
+        mid-column leaves no pollable job and the deterministic-id retry
+        rewrites the orphaned tmp/column files from scratch."""
+        if (
+            self._done(job.clerk).get(job.id) is not None
+            or self._queue(job.clerk).get(job.id) is not None
+        ):
+            return  # idempotent: don't consume the iterator either
+        column, chunks = split_small_column(chunks, job_page_threshold())
+        if column is not None:
+            # small column: keep the legacy inline layout
+            job.encryptions = column
+            _create(self._queue(job.clerk), job.id, job.to_json())
+            return
+        data_path, idx_path = self._column_paths(job.id)
+        tmp_data, tmp_idx = data_path + ".tmp", idx_path + ".tmp"
+        total = 0
+        try:
+            with open(tmp_data, "wb") as df, open(tmp_idx, "wb") as xf:
+                off = 0
+                xf.write(struct.pack("<Q", 0))
+                for block in chunks:
+                    lines = [
+                        json.dumps(e.to_json()).encode("utf-8") + b"\n"
+                        for e in block
+                    ]
+                    df.write(b"".join(lines))
+                    for line in lines:
+                        off += len(line)
+                        xf.write(struct.pack("<Q", off))
+                    total += len(block)
+            os.replace(tmp_data, data_path)
+            os.replace(tmp_idx, idx_path)
+        finally:
+            for tmp in (tmp_data, tmp_idx):
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        meta = ClerkingJob(
+            id=job.id,
+            clerk=job.clerk,
+            aggregation=job.aggregation,
+            snapshot=job.snapshot,
+            encryptions=[],
+            total_encryptions=total,
+        )
+        _create(self._queue(job.clerk), job.id, meta.to_json())
 
     def poll_clerking_job(self, clerk_id):
         queue = self._queue(clerk_id)
         ids = queue.list_ids()
         if not ids:
             return None
-        return ClerkingJob.from_json(queue.get(ids[0]))
+        return self._deliver(queue.get(ids[0]))
 
     def get_clerking_job(self, clerk_id, job_id):
         payload = self._queue(clerk_id).get(job_id) or self._done(clerk_id).get(job_id)
-        return None if payload is None else ClerkingJob.from_json(payload)
+        return None if payload is None else self._deliver(payload)
+
+    def get_clerking_job_chunk(self, clerk_id, job_id, start, count):
+        payload = self._queue(clerk_id).get(job_id) or self._done(clerk_id).get(job_id)
+        if payload is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        job = ClerkingJob.from_json(payload)
+        if job.total_encryptions is None:
+            return job.encryptions[start : start + count]  # inline layout
+        end = min(start + count, job.total_encryptions)
+        return self._read_column_range(job.id, start, end)
 
     def create_clerking_result(self, result) -> None:
-        job = self.get_clerking_job(result.clerk, result.job)
-        if job is None:
+        # raw stored payload, not the delivered view: the done-dir copy
+        # must keep the stored layout (meta for externalized jobs) so the
+        # column file stays addressable after completion
+        payload = self._queue(result.clerk).get(result.job) or self._done(
+            result.clerk
+        ).get(result.job)
+        if payload is None:
             raise InvalidRequestError(f"no job {result.job}")
+        job = ClerkingJob.from_json(payload)
         self._results(job.snapshot).put(job.id, result.to_json())
         # move queue -> done so the job is no longer pollable but stays auditable
-        self._done(job.clerk).put(job.id, job.to_json())
+        self._done(job.clerk).put(job.id, payload)
         self._queue(job.clerk).delete(job.id)
 
     def list_results(self, snapshot_id) -> list:
@@ -357,3 +543,14 @@ class FileClerkingJobsStore(ClerkingJobsStore):
     def get_result(self, snapshot_id, job_id):
         payload = self._results(snapshot_id).get(job_id)
         return None if payload is None else ClerkingResult.from_json(payload)
+
+    def get_results(self, snapshot_id) -> list:
+        # one directory scan in list_ids order (canonical str sort)
+        results = self._results(snapshot_id)
+        out = []
+        for job_id in results.list_ids():
+            payload = results.get(job_id)
+            if payload is None:
+                raise ServerError("inconsistent storage")
+            out.append(ClerkingResult.from_json(payload))
+        return out

@@ -9,11 +9,18 @@ dev server; the file/sqlite backends mirror its behavior durably.
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Optional
 
 from ..protocol import InvalidRequestError, ServerError
-from .stores import AggregationsStore, AgentsStore, AuthTokensStore, ClerkingJobsStore
+from .stores import (
+    AggregationsStore,
+    AgentsStore,
+    AuthTokensStore,
+    ClerkingJobsStore,
+    paged_job_view,
+)
 
 
 def _create_if_identical(table: dict, key, value) -> None:
@@ -212,8 +219,12 @@ class MemAggregationsStore(AggregationsStore):
 class MemClerkingJobsStore(ClerkingJobsStore):
     def __init__(self):
         self._lock = threading.RLock()
-        self._queues: dict = {}  # AgentId -> [ClerkingJob] (FIFO, pending)
+        # per-clerk FIFO of pending job ids: poll peeks the head in O(1)
+        # instead of rebuilding/scanning a job list (done jobs are lazily
+        # popped off the head on the next poll)
+        self._queues: dict = {}  # AgentId -> deque[ClerkingJobId]
         self._jobs: dict = {}  # ClerkingJobId -> ClerkingJob
+        self._done: set = set()  # ClerkingJobIds with a posted result
         self._results: dict = {}  # SnapshotId -> {ClerkingJobId: ClerkingResult}
 
     def enqueue_clerking_job(self, job) -> None:
@@ -222,12 +233,18 @@ class MemClerkingJobsStore(ClerkingJobsStore):
             if job.id in self._jobs:
                 return
             self._jobs[job.id] = job
-            self._queues.setdefault(job.clerk, []).append(job)
+            self._queues.setdefault(job.clerk, collections.deque()).append(job.id)
 
     def poll_clerking_job(self, clerk_id):
         with self._lock:
-            queue = self._queues.get(clerk_id, [])
-            return queue[0] if queue else None
+            queue = self._queues.get(clerk_id)
+            while queue:
+                job_id = queue[0]
+                if job_id in self._done:
+                    queue.popleft()  # amortized O(1): each id pops once
+                    continue
+                return paged_job_view(self._jobs[job_id])
+            return None
 
     def get_clerking_job(self, clerk_id, job_id):
         with self._lock:
@@ -236,14 +253,22 @@ class MemClerkingJobsStore(ClerkingJobsStore):
                 return None
             return job
 
+    def get_clerking_job_chunk(self, clerk_id, job_id, start, count):
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.clerk != clerk_id:
+                return None
+            if start < 0 or count < 0:
+                return []
+            return job.encryptions[start : start + count]
+
     def create_clerking_result(self, result) -> None:
         with self._lock:
             job = self._jobs.get(result.job)
             if job is None:
                 raise InvalidRequestError(f"no job {result.job}")
             self._results.setdefault(job.snapshot, {})[job.id] = result
-            queue = self._queues.get(job.clerk, [])
-            self._queues[job.clerk] = [j for j in queue if j.id != job.id]
+            self._done.add(job.id)
 
     def list_results(self, snapshot_id) -> list:
         # job-id order: every store returns the same canonical ordering
@@ -255,3 +280,8 @@ class MemClerkingJobsStore(ClerkingJobsStore):
     def get_result(self, snapshot_id, job_id):
         with self._lock:
             return self._results.get(snapshot_id, {}).get(job_id)
+
+    def get_results(self, snapshot_id) -> list:
+        with self._lock:
+            table = self._results.get(snapshot_id, {})
+            return [table[job_id] for job_id in sorted(table.keys(), key=str)]

@@ -25,6 +25,7 @@ from ..protocol import (
     SnapshotStatus,
 )
 from . import snapshot as snapshot_mod
+from . import stores
 
 
 class SdaServer:
@@ -298,6 +299,11 @@ class SdaServer:
     def get_clerking_job(self, clerk_id, job_id):
         return self.clerking_job_store.get_clerking_job(clerk_id, job_id)
 
+    def get_clerking_job_chunk(self, clerk_id, job_id, start, count):
+        return self.clerking_job_store.get_clerking_job_chunk(
+            clerk_id, job_id, start, count
+        )
+
     def create_clerking_result(self, result) -> None:
         self.clerking_job_store.create_clerking_result(result)
 
@@ -308,12 +314,9 @@ class SdaServer:
         # aggregation/snapshot spoofing", server.rs:324; fixed here).
         if self.aggregation_store.get_snapshot(aggregation_id, snapshot_id) is None:
             return None
-        results = []
-        for job_id in self.clerking_job_store.list_results(snapshot_id):
-            result = self.clerking_job_store.get_result(snapshot_id, job_id)
-            if result is None:
-                raise ServerError("inconsistent storage")
-            results.append(result)
+        # one bulk read (backends: single query/scan) — the old
+        # list_results + get_result-per-job loop was an N+1
+        results = self.clerking_job_store.get_results(snapshot_id)
         return SnapshotResult(
             snapshot=snapshot_id,
             number_of_participations=self.aggregation_store.count_participations_snapshot(
@@ -487,6 +490,13 @@ class SdaServerService(SdaService):
     def get_clerking_job(self, caller, clerk_id):
         _acl_agent_is(caller, clerk_id)
         return self.server.poll_clerking_job(clerk_id)
+
+    def get_clerking_job_chunk(self, caller, job_id, start):
+        # ownership is implied: the store's chunk lookup is keyed by
+        # (clerk, job) and answers None unless the CALLER owns the job —
+        # another clerk's job id reads as not-found, never as data
+        count = stores.job_chunk_size()
+        return self.server.get_clerking_job_chunk(caller.id, job_id, start, count)
 
     def create_clerking_result(self, caller, result) -> None:
         # double check the job really belongs to the caller (server.rs:351-360)

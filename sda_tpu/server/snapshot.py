@@ -14,6 +14,7 @@ import uuid
 
 from ..protocol import ClerkingJob, ClerkingJobId, ServerError
 from ..utils.metrics import get_metrics
+from . import stores as stores_mod
 
 log = logging.getLogger("sda.server.snapshot")
 
@@ -59,31 +60,40 @@ def run_snapshot(server, snapshot) -> None:
         server.aggregation_store.validate_snapshot_clerk_jobs(
             snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
         )
+        # chunked write-through: each clerk column flows to the job store
+        # as an iterator of ranges, so peak memory is one chunk — not one
+        # full column per clerk (the old iter_snapshot_clerk_jobs_data
+        # path, still in place for callers that want whole columns)
         per_clerk = iter(
-            server.aggregation_store.iter_snapshot_clerk_jobs_data(
-                snapshot.aggregation, snapshot.id, len(committee.clerks_and_keys)
+            server.aggregation_store.iter_snapshot_clerk_jobs_chunks(
+                snapshot.aggregation,
+                snapshot.id,
+                len(committee.clerks_and_keys),
+                stores_mod.job_chunk_size(),
             )
         )
     for ix, (clerk_id, _) in enumerate(committee.clerks_and_keys):
-        # lazy backends (file-store column scans) do their I/O at next();
-        # time it under the transpose phase, not the enqueue phase
         with metrics.phase("snapshot.transpose"):
             try:
-                encryptions = next(per_clerk)
+                chunks = next(per_clerk)
             except StopIteration:
                 raise ServerError(
                     f"transpose yielded fewer than "
                     f"{len(committee.clerks_and_keys)} clerk columns"
                 )
+        # lazy backends do the column I/O as the enqueue consumes the
+        # chunk iterator, so transpose and enqueue costs land in the
+        # enqueue phase here (the chunked path interleaves them by design)
         with metrics.phase("snapshot.enqueue"):
-            server.clerking_job_store.enqueue_clerking_job(
+            server.clerking_job_store.enqueue_clerking_job_chunked(
                 ClerkingJob(
                     id=_job_id(snapshot.id, ix),
                     clerk=clerk_id,
                     aggregation=snapshot.aggregation,
                     snapshot=snapshot.id,
-                    encryptions=encryptions,
-                )
+                    encryptions=[],
+                ),
+                chunks,
             )
 
     if aggregation.masking_scheme.has_mask():

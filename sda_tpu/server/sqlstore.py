@@ -54,7 +54,15 @@ from ..protocol import (
     signed_encryption_key_from_json,
 )
 from ..protocol.ids import AgentId, AggregationId, ClerkingJobId, SnapshotId
-from .stores import AggregationsStore, AgentsStore, AuthTokensStore, ClerkingJobsStore
+from .stores import (
+    AggregationsStore,
+    AgentsStore,
+    AuthTokensStore,
+    ClerkingJobsStore,
+    job_chunk_size,
+    job_page_threshold,
+    split_small_column,
+)
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS agents (id TEXT PRIMARY KEY, body TEXT NOT NULL);
@@ -82,6 +90,9 @@ CREATE TABLE IF NOT EXISTS jobs (
     id TEXT PRIMARY KEY, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
     done INTEGER NOT NULL DEFAULT 0, body TEXT NOT NULL);
 CREATE INDEX IF NOT EXISTS jobs_clerk ON jobs (clerk, done);
+CREATE TABLE IF NOT EXISTS job_encs (
+    job TEXT NOT NULL, pos INTEGER NOT NULL, body TEXT NOT NULL,
+    PRIMARY KEY (job, pos)) WITHOUT ROWID;
 CREATE TABLE IF NOT EXISTS results (
     job TEXT PRIMARY KEY, snapshot TEXT NOT NULL, body TEXT NOT NULL);
 CREATE INDEX IF NOT EXISTS results_snapshot ON results (snapshot);
@@ -568,6 +579,38 @@ class SqliteAggregationsStore(AggregationsStore):
 
         return (column(ix) for ix in range(clerks_number))
 
+    def iter_snapshot_clerk_jobs_chunks(
+        self, aggregation_id, snapshot_id, clerks_number: int, chunk_size: int
+    ):
+        """Chunked streaming transpose: same json_extract column pull as
+        ``iter_snapshot_clerk_jobs_data``, but each chunk is its own
+        ord-range query, so peak memory per clerk drops from one column
+        to one chunk. Same complete-query-per-batch and loud short-batch
+        rules as ``iter_snapped_participations``."""
+        s = str(snapshot_id)
+        total = self.count_participations_snapshot(aggregation_id, snapshot_id)
+
+        def column_chunks(ix: int):
+            for lo in range(0, total, chunk_size):
+                want = min(chunk_size, total - lo)
+                rows = self.db.query_all(
+                    "SELECT json_extract(p.body, '$.clerk_encryptions[' || ? || '][1]') "
+                    "FROM snapshot_members m "
+                    "JOIN participations p ON p.id = m.participation "
+                    "WHERE m.snapshot = ? AND m.ord >= ? AND m.ord < ? "
+                    "ORDER BY m.ord",
+                    (ix, s, lo, lo + chunk_size),
+                )
+                if len(rows) != want:
+                    raise ServerError(
+                        f"snapshot {snapshot_id}: snapped rows vanished "
+                        f"mid-transpose (ord [{lo},{lo + chunk_size}) returned "
+                        f"{len(rows)}/{want}) — store mutated during iteration?"
+                    )
+                yield [Encryption.from_json(json.loads(r[0])) for r in rows]
+
+        return (column_chunks(ix) for ix in range(clerks_number))
+
     def create_snapshot_mask(self, snapshot_id, mask: list) -> None:
         self.db.execute(
             "INSERT INTO snapshot_masks (snapshot, body) VALUES (?, ?) "
@@ -585,10 +628,40 @@ class SqliteAggregationsStore(AggregationsStore):
 
 
 class SqliteClerkingJobsStore(ClerkingJobsStore):
+    """Two column layouts coexist:
+
+    - INLINE (legacy / small jobs): the full ciphertext column lives in
+      ``jobs.body`` — the original wire shape, parsed and sliced on
+      demand.
+    - EXTERNALIZED (chunked enqueue, or plain enqueue above the paging
+      threshold): ``jobs.body`` is the metadata-only job
+      (``total_encryptions`` set, ``encryptions`` empty) and the column
+      lives as one ``job_encs`` row per ciphertext, keyed (job, pos), so
+      a chunk read is an indexed range scan and never materializes the
+      column.
+
+    Delivery shape is decided at poll time from the CURRENT paging
+    threshold: small externalized jobs are reassembled into the
+    monolithic wire body (byte-identical to inline — both re-serialize
+    through the same dataclasses), large inline jobs are paged by view.
+    """
+
     def __init__(self, backend: SqliteBackend):
         self.db = backend
 
     def enqueue_clerking_job(self, job) -> None:
+        if len(job.encryptions) > job_page_threshold():
+            self.enqueue_clerking_job_chunked(
+                ClerkingJob(
+                    id=job.id,
+                    clerk=job.clerk,
+                    aggregation=job.aggregation,
+                    snapshot=job.snapshot,
+                    encryptions=[],
+                ),
+                [job.encryptions],
+            )
+            return
         with self.db.transaction() as conn:
             row = conn.execute(
                 "SELECT id FROM jobs WHERE id = ?", (str(job.id),)
@@ -600,19 +673,126 @@ class SqliteClerkingJobsStore(ClerkingJobsStore):
                 (str(job.id), str(job.clerk), str(job.snapshot), json.dumps(job.to_json())),
             )
 
+    def enqueue_clerking_job_chunked(self, job, chunks) -> None:
+        """Streaming enqueue: small columns (within the paging threshold)
+        keep the legacy inline layout; larger ones land externalized in
+        one write transaction, one executemany per range, never more
+        than one range of the column in memory. The jobs row (with the
+        final total) lands last, inside the same transaction, so a crash
+        mid-column leaves no visible job and the deterministic-id retry
+        rewrites from scratch."""
+        job_key = str(job.id)
+        if (
+            self.db.query_one("SELECT id FROM jobs WHERE id = ?", (job_key,))
+            is not None
+        ):
+            return  # idempotent: don't consume the iterator either
+        column, chunks = split_small_column(chunks, job_page_threshold())
+        if column is not None:
+            job.encryptions = column
+            self.enqueue_clerking_job(job)
+            return
+        with self.db.transaction() as conn:
+            row = conn.execute(
+                "SELECT id FROM jobs WHERE id = ?", (job_key,)
+            ).fetchone()
+            if row is not None:
+                return  # lost a race to a concurrent retry: same bytes
+            # defensive: an aborted prior transaction can't leave rows
+            # (transactional), but a stale manual write could
+            conn.execute("DELETE FROM job_encs WHERE job = ?", (job_key,))
+            pos = 0
+            for block in chunks:
+                conn.executemany(
+                    "INSERT INTO job_encs (job, pos, body) VALUES (?, ?, ?)",
+                    [
+                        (job_key, pos + i, json.dumps(enc.to_json()))
+                        for i, enc in enumerate(block)
+                    ],
+                )
+                pos += len(block)
+            meta = ClerkingJob(
+                id=job.id,
+                clerk=job.clerk,
+                aggregation=job.aggregation,
+                snapshot=job.snapshot,
+                encryptions=[],
+                total_encryptions=pos,
+            )
+            conn.execute(
+                "INSERT INTO jobs (id, clerk, snapshot, done, body) VALUES (?, ?, ?, 0, ?)",
+                (job_key, str(job.clerk), str(job.snapshot), json.dumps(meta.to_json())),
+            )
+
+    def _deliver(self, job):
+        """Stored body -> wire body under the current paging threshold."""
+        total = (
+            job.total_encryptions
+            if job.total_encryptions is not None
+            else len(job.encryptions)
+        )
+        if total > job_page_threshold():
+            return ClerkingJob(
+                id=job.id,
+                clerk=job.clerk,
+                aggregation=job.aggregation,
+                snapshot=job.snapshot,
+                encryptions=[],
+                total_encryptions=total,
+                chunk_size=job_chunk_size(),
+            )
+        if job.total_encryptions is None:
+            return job  # inline + small: original shape, untouched
+        # externalized + small: reassemble the monolithic wire body
+        rows = self.db.query_all(
+            "SELECT body FROM job_encs WHERE job = ? ORDER BY pos", (str(job.id),)
+        )
+        return ClerkingJob(
+            id=job.id,
+            clerk=job.clerk,
+            aggregation=job.aggregation,
+            snapshot=job.snapshot,
+            encryptions=[Encryption.from_json(json.loads(r[0])) for r in rows],
+        )
+
     def poll_clerking_job(self, clerk_id):
         row = self.db.query_one(
             "SELECT body FROM jobs WHERE clerk = ? AND done = 0 ORDER BY id LIMIT 1",
             (str(clerk_id),),
         )
-        return None if row is None else ClerkingJob.from_json(json.loads(row[0]))
+        if row is None:
+            return None
+        return self._deliver(ClerkingJob.from_json(json.loads(row[0])))
 
     def get_clerking_job(self, clerk_id, job_id):
         row = self.db.query_one(
             "SELECT body FROM jobs WHERE id = ? AND clerk = ?",
             (str(job_id), str(clerk_id)),
         )
-        return None if row is None else ClerkingJob.from_json(json.loads(row[0]))
+        if row is None:
+            return None
+        return self._deliver(ClerkingJob.from_json(json.loads(row[0])))
+
+    def get_clerking_job_chunk(self, clerk_id, job_id, start, count):
+        row = self.db.query_one(
+            "SELECT body FROM jobs WHERE id = ? AND clerk = ?",
+            (str(job_id), str(clerk_id)),
+        )
+        if row is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        job = ClerkingJob.from_json(json.loads(row[0]))
+        if job.total_encryptions is None:
+            return job.encryptions[start : start + count]  # inline layout
+        # externalized: indexed (job, pos) range scan — reads ONLY the
+        # requested rows, the whole point of the layout
+        rows = self.db.query_all(
+            "SELECT body FROM job_encs WHERE job = ? AND pos >= ? AND pos < ? "
+            "ORDER BY pos",
+            (str(job_id), start, start + count),
+        )
+        return [Encryption.from_json(json.loads(r[0])) for r in rows]
 
     def create_clerking_result(self, result) -> None:
         with self.db.transaction() as conn:
@@ -642,3 +822,12 @@ class SqliteClerkingJobsStore(ClerkingJobsStore):
             (str(job_id), str(snapshot_id)),
         )
         return None if row is None else ClerkingResult.from_json(json.loads(row[0]))
+
+    def get_results(self, snapshot_id) -> list:
+        # one indexed scan replaces the list_results + get_result-per-job
+        # N+1; ORDER BY job keeps the canonical cross-backend ordering
+        rows = self.db.query_all(
+            "SELECT body FROM results WHERE snapshot = ? ORDER BY job",
+            (str(snapshot_id),),
+        )
+        return [ClerkingResult.from_json(json.loads(r[0])) for r in rows]

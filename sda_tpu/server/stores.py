@@ -15,12 +15,65 @@ disk spill; the TPU fabric does it as an all_to_all when tensor-resident).
 from __future__ import annotations
 
 import abc
+import os
 from typing import Iterable, Iterator, Optional
 
-from ..protocol import Labelled
+from ..protocol import Labelled, ServerError
 
 # AuthToken = Labelled[AgentId, str] (stores.rs:8)
 AuthToken = Labelled
+
+
+def job_page_threshold() -> int:
+    """Encryption count above which ``poll_clerking_job`` delivers paged
+    metadata instead of the monolithic body. Read per call so tests (and
+    operators) can flip it without rebuilding stores; <= 0 pages every
+    job."""
+    return int(os.environ.get("SDA_JOB_PAGE_THRESHOLD", "8192"))
+
+
+def job_chunk_size() -> int:
+    """Server-suggested chunk length for paged delivery and for the
+    chunked transpose write-through. Clamped to >= 1."""
+    return max(1, int(os.environ.get("SDA_JOB_CHUNK_SIZE", "4096")))
+
+
+def split_small_column(chunks, threshold: int):
+    """Consume ``chunks`` just far enough to learn whether the column
+    fits within ``threshold`` ciphertexts. Returns ``(column, None)``
+    with the full materialized column when it does — small jobs keep the
+    legacy inline layout — or ``(None, iterator)`` where the iterator
+    replays the buffered prefix and then the remaining ranges. Peak
+    memory is one threshold's worth either way."""
+    import itertools
+
+    buffered: list = []
+    total = 0
+    it = iter(chunks)
+    for block in it:
+        buffered.append(block)
+        total += len(block)
+        if total > threshold:
+            return None, itertools.chain(buffered, it)
+    return [enc for block in buffered for enc in block], None
+
+
+def paged_job_view(job):
+    """The wire view of a job under paged delivery: metadata only, the
+    ciphertext column left behind for ``get_clerking_job_chunk``. Small
+    jobs pass through untouched so the original wire shape survives."""
+    total = len(job.encryptions) if job.total_encryptions is None else job.total_encryptions
+    if total <= job_page_threshold():
+        return job
+    return type(job)(
+        id=job.id,
+        clerk=job.clerk,
+        aggregation=job.aggregation,
+        snapshot=job.snapshot,
+        encryptions=[],
+        total_encryptions=total,
+        chunk_size=job_chunk_size(),
+    )
 
 
 class BaseStore(abc.ABC):
@@ -166,6 +219,36 @@ class AggregationsStore(BaseStore):
                 shares[ix].append(enc)
         return shares
 
+    def iter_snapshot_clerk_jobs_chunks(
+        self, aggregation_id, snapshot_id, clerks_number: int, chunk_size: int
+    ) -> Iterable:
+        """Chunked transpose: an iterable of ``clerks_number`` column
+        iterators, each yielding ``chunk_size``-long ciphertext ranges in
+        participant order. Same single-use, committee-order contract as
+        ``iter_snapshot_clerk_jobs_data``; this is what keeps snapshot
+        enqueue memory at one chunk instead of one full column per clerk.
+        The default re-chunks the column transpose (eager backends gain
+        nothing, which is fine: they already hold everything in memory);
+        sqlite and the file store override with genuinely ranged reads.
+        """
+
+        def chunks_of(column):
+            it = iter(column)
+            while True:
+                block = []
+                for enc in it:
+                    block.append(enc)
+                    if len(block) >= chunk_size:
+                        break
+                if not block:
+                    return
+                yield block
+
+        for column in self.iter_snapshot_clerk_jobs_data(
+            aggregation_id, snapshot_id, clerks_number
+        ):
+            yield chunks_of(column)
+
     @abc.abstractmethod
     def create_snapshot_mask(self, snapshot_id, mask: list) -> None: ...
 
@@ -177,14 +260,50 @@ class ClerkingJobsStore(BaseStore):
     @abc.abstractmethod
     def enqueue_clerking_job(self, job) -> None: ...
 
+    def enqueue_clerking_job_chunked(self, job, chunks: Iterable) -> None:
+        """Enqueue ``job`` (its ``encryptions`` empty) with the ciphertext
+        column supplied as an iterator of ranges, in participant order.
+
+        The streaming half of the chunked transpose: backends with an
+        external column representation (sqlite rows, file-store column
+        files) write ranges through without ever holding the full column;
+        this default materializes for purely in-memory backends, which
+        hold the whole queue anyway. Must keep ``enqueue_clerking_job``'s
+        idempotence: re-enqueueing an existing job id is a no-op."""
+        encryptions = []
+        for block in chunks:
+            encryptions.extend(block)
+        job.encryptions = encryptions
+        self.enqueue_clerking_job(job)
+
     @abc.abstractmethod
     def poll_clerking_job(self, clerk_id):
         """First not-yet-done job for the clerk; jobs stay queued until a
         result is posted, so a crashed clerk re-polls the same job
-        (jfs_stores/clerking_jobs.rs:40-59)."""
+        (jfs_stores/clerking_jobs.rs:40-59). Jobs above
+        ``job_page_threshold()`` are returned as paged metadata (see
+        ``paged_job_view``); the column is then read range-by-range via
+        ``get_clerking_job_chunk``."""
 
     @abc.abstractmethod
     def get_clerking_job(self, clerk_id, job_id): ...
+
+    def get_clerking_job_chunk(
+        self, clerk_id, job_id, start: int, count: int
+    ) -> Optional[list]:
+        """Ciphertexts ``[start, start+count)`` of the job's column, or
+        None when the job doesn't exist / isn't the clerk's. Ranges past
+        the end return the (possibly empty) tail — polling clients stop
+        on their own count, and an empty list is a valid answer. Backends
+        override to read ONLY the requested range (sqlite: indexed
+        position rows; file store: byte-offset seek); this default slices
+        the materialized job for in-memory layouts."""
+        job = self.get_clerking_job(clerk_id, job_id)
+        if job is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        return job.encryptions[start : start + count]
 
     @abc.abstractmethod
     def create_clerking_result(self, result) -> None: ...
@@ -194,3 +313,16 @@ class ClerkingJobsStore(BaseStore):
 
     @abc.abstractmethod
     def get_result(self, snapshot_id, job_id): ...
+
+    def get_results(self, snapshot_id) -> list:
+        """All ClerkingResults for the snapshot in ``list_results`` order
+        (sorted by str(job_id) — canonical across backends). Bulk
+        replacement for the get_result-per-job loop; backends override
+        with a single scan/query."""
+        results = []
+        for job_id in self.list_results(snapshot_id):
+            result = self.get_result(snapshot_id, job_id)
+            if result is None:
+                raise ServerError("inconsistent storage")
+            results.append(result)
+        return results

@@ -111,21 +111,48 @@ def main() -> int:
     delta = peak - rss_before
 
     # spot-verify routing without materializing every column at once:
-    # clerk 0's whole column, then first/last markers of the rest
+    # clerk 0's whole column, then first/last markers of the rest.
+    # Cohorts above SDA_JOB_PAGE_THRESHOLD arrive PAGED (metadata poll +
+    # ranged chunk reads) — which is also how the column stays unmaterialized
     agent_by_id = {a.id: a for a, _ in agents}
-    job0 = service.get_clerking_job(agent_by_id[clerks[0].id], clerks[0].id)
-    assert len(job0.encryptions) == n, len(job0.encryptions)
+
+    def column_meta(clerk_agent, clerk_id):
+        job = service.get_clerking_job(clerk_agent, clerk_id)
+        total = (
+            job.total_encryptions if job.is_paged() else len(job.encryptions)
+        )
+        assert total == n, total
+        return job
+
+    def iter_column(clerk_agent, job):
+        if not job.is_paged():
+            yield from job.encryptions
+            return
+        start = 0
+        while start < job.total_encryptions:
+            chunk = service.get_clerking_job_chunk(clerk_agent, job.id, start)
+            assert chunk, f"column truncated at {start}"
+            yield from chunk
+            start += len(chunk)
+
+    clerk0 = agent_by_id[clerks[0].id]
+    job0 = column_meta(clerk0, clerks[0].id)
     seen = set()
-    for enc in job0.encryptions:
+    for enc in iter_column(clerk0, job0):
         raw = bytes(enc.inner)
         assert raw[0] == 0, "ciphertext routed to the wrong clerk"
         seen.add(marker_participant_index(raw))
     assert seen == set(range(n)), "participants lost/duplicated"
     for ci in range(1, n_clerks):
-        job = service.get_clerking_job(agent_by_id[clerks[ci].id], clerks[ci].id)
-        assert len(job.encryptions) == n
-        assert bytes(job.encryptions[0].inner)[0] == ci
-        assert bytes(job.encryptions[-1].inner)[0] == ci
+        clerk = agent_by_id[clerks[ci].id]
+        job = column_meta(clerk, clerks[ci].id)
+        if job.is_paged():
+            first = service.get_clerking_job_chunk(clerk, job.id, 0)[0]
+            last = service.get_clerking_job_chunk(clerk, job.id, n - 1)[0]
+        else:
+            first, last = job.encryptions[0], job.encryptions[-1]
+        assert bytes(first.inner)[0] == ci
+        assert bytes(last.inner)[0] == ci
 
     # Flatness bound: generous per-object budget for ONE clerk column
     # (Encryption + Binary + bytes + list slot ~ 300 B) plus allocator

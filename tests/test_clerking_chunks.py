@@ -1,0 +1,325 @@
+"""Paged clerking-job delivery: chunked pipeline must be indistinguishable
+from monolithic delivery.
+
+The tentpole contract: delivery shape (monolithic wire body vs paged
+metadata + chunk GETs) is decided at POLL time from the paging threshold,
+while the storage layout (inline vs externalized rows) is decided at
+ENQUEUE time — so one stored job can be polled BOTH ways. Each matrix
+config stores the column externalized (threshold 0 at snapshot time),
+processes the SAME job once monolithically and once through the chunked
+prefetch pipeline, and asserts the decrypted combined share vectors are
+byte-identical. (The ClerkingResult ciphertexts themselves can't be
+compared — sealed boxes are randomized — so equivalence is asserted on
+the recipient-decrypted plaintexts, which is what reconstruction sees.)
+
+Covers {additive, basic Shamir, packed Shamir} x chunk sizes {1, 7, 4096}
+spread across mem/file/sqlite and in-process/REST bindings, plus the
+empty-snapshot cut and a mid-download server-restart retry.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import tempfile
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup, with_service
+from sda_tpu.client import SdaClient
+from sda_tpu.crypto import Keystore
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    BasicShamirSharing,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+
+SCHEMES = {
+    "additive": lambda: AdditiveSharing(share_count=3, modulus=433),
+    "shamir": lambda: BasicShamirSharing(
+        share_count=5, privacy_threshold=2, prime_modulus=433
+    ),
+    "packed": lambda: PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=433,
+        omega_secrets=354,
+        omega_shares=150,
+    ),
+}
+
+# every scheme meets every chunk size; stores and bindings are spread so
+# each store sees multiple chunk sizes and the REST chunk route is
+# exercised against the sqlite ranged reads
+MATRIX = [
+    ("additive", 1, "mem", False),
+    ("additive", 7, "sqlite", True),
+    ("additive", 4096, "file", False),
+    ("shamir", 1, "sqlite", True),
+    ("shamir", 7, "file", False),
+    ("shamir", 4096, "mem", False),
+    ("packed", 1, "file", False),
+    ("packed", 7, "mem", False),
+    ("packed", 4096, "sqlite", True),
+]
+
+N_PARTICIPANTS = 9  # 9 with chunk 7 -> one full + one ragged chunk
+
+
+def _configure(monkeypatch, store: str, http: bool) -> None:
+    if store == "mem":
+        monkeypatch.delenv("SDA_TEST_STORE", raising=False)
+    else:
+        monkeypatch.setenv("SDA_TEST_STORE", store)
+    monkeypatch.setenv("SDA_TEST_HTTP", "1" if http else "0")
+
+
+def _new_aggregation(recipient, rkey, scheme) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="clerking-chunks",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=scheme,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+@pytest.mark.parametrize("scheme_name,chunk_size,store,http", MATRIX)
+def test_paged_equals_monolithic(
+    tmp_path, monkeypatch, scheme_name, chunk_size, store, http
+):
+    _configure(monkeypatch, store, http)
+    scheme = SCHEMES[scheme_name]()
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=scheme.output_size
+        )
+        agg = _new_aggregation(recipient, rkey, scheme)
+        recipient.upload_aggregation(agg)
+        # pin the committee to OUR clerks — the keyed recipient is also a
+        # candidate and must not be drafted in a clerk's place
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+
+        participant = new_client(tmp_path / "participant", ctx.service)
+        participant.upload_agent()
+        values = [[i % 5, (i + 2) % 5, 1, 0] for i in range(N_PARTICIPANTS)]
+        participant.upload_participations(
+            participant.new_participations(values, agg.id)
+        )
+
+        # externalize the stored columns: threshold 0 at snapshot time
+        # forces the chunked enqueue layout on backends that have one
+        monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+        monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", str(chunk_size))
+        recipient.end_aggregation(agg.id)
+
+        decryptor = recipient.crypto.new_share_decryptor(
+            rkey, agg.recipient_encryption_scheme
+        )
+        for clerk in clerks:
+            # SAME stored job, monolithic delivery: raising the threshold
+            # above the column size reassembles the full wire body
+            monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "1000000")
+            job_mono = ctx.service.get_clerking_job(clerk.agent, clerk.agent.id)
+            assert job_mono is not None and not job_mono.is_paged()
+            assert len(job_mono.encryptions) == N_PARTICIPANTS
+            res_mono = clerk.process_clerking_job(job_mono)
+
+            # ... and paged delivery through the prefetch pipeline
+            monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+            job_paged = ctx.service.get_clerking_job(clerk.agent, clerk.agent.id)
+            assert job_paged is not None and job_paged.is_paged()
+            assert job_paged.id == job_mono.id
+            assert job_paged.total_encryptions == N_PARTICIPANTS
+            assert job_paged.encryptions == []
+            res_paged = clerk.process_clerking_job(job_paged)
+
+            np.testing.assert_array_equal(
+                decryptor.decrypt(res_mono.encryption),
+                decryptor.decrypt(res_paged.encryption),
+            )
+            ctx.service.create_clerking_result(clerk.agent, res_paged)
+
+        expected = [
+            sum(v[d] for v in values) % agg.modulus
+            for d in range(agg.vector_dimension)
+        ]
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.parametrize(
+    "store,http", [("mem", False), ("sqlite", True), ("file", False)]
+)
+def test_empty_snapshot_cut(tmp_path, monkeypatch, store, http):
+    """A snapshot with zero participations must round-trip under paging
+    env too: empty columns never page (0 > threshold is false for any
+    threshold), every clerk combines the empty set, and the reveal is
+    the zero vector."""
+    _configure(monkeypatch, store, http)
+    monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", "7")
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=3
+        )
+        agg = _new_aggregation(
+            recipient, rkey, AdditiveSharing(share_count=3, modulus=433)
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        recipient.end_aggregation(agg.id)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [0, 0, 0, 0])
+
+
+def test_mid_download_restart_retry(tmp_path, monkeypatch):
+    """A clerk interrupted mid-download retries against a restarted
+    server: the externalized column is durable in sqlite, the re-polled
+    job carries the same id and metadata, chunk 0 re-reads identically,
+    and the completed round reveals the exact aggregate."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_sqlite_server
+
+    monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", "8")
+    db_path = str(tmp_path / "sda.db")
+    tokens = str(tmp_path / "tokens")
+    n = 40
+    values = [[i % 5, 1, 2, 3] for i in range(n)]
+
+    keystores = {}
+
+    def client_for(name, service):
+        if name not in keystores:
+            ks = Keystore(str(tmp_path / name))
+            keystores[name] = (ks, SdaClient.new_agent(ks))
+        ks, agent = keystores[name]
+        return SdaClient(agent, ks, service)
+
+    with serve_background(new_sqlite_server(db_path)) as url:
+        service = SdaHttpClient(url, TokenStore(tokens))
+        recipient = client_for("r", service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerk_clients = [client_for(f"c{i}", service) for i in range(2)]
+        for c in clerk_clients:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _new_aggregation(
+            recipient, rkey, AdditiveSharing(share_count=2, modulus=433)
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerk_clients]
+        )
+        participant = client_for("p", service)
+        participant.upload_agent()
+        participant.participate_many(values, agg.id, chunk_size=16)
+        recipient.end_aggregation(agg.id)
+
+        clerk = clerk_clients[0]
+        job_before = service.get_clerking_job(clerk.agent, clerk.agent.id)
+        assert job_before is not None and job_before.is_paged()
+        assert job_before.total_encryptions == n
+        chunk0_before = service.get_clerking_job_chunk(
+            clerk.agent, job_before.id, 0
+        )
+        assert len(chunk0_before) == 8
+        # ... and the clerk "crashes" here, mid-download
+
+    with serve_background(new_sqlite_server(db_path)) as url:
+        service = SdaHttpClient(url, TokenStore(tokens))
+        recipient = client_for("r", service)
+        clerk_clients = [client_for(f"c{i}", service) for i in range(2)]
+
+        clerk = clerk_clients[0]
+        job_after = service.get_clerking_job(clerk.agent, clerk.agent.id)
+        assert job_after is not None and job_after.is_paged()
+        assert job_after.id == job_before.id
+        assert job_after.total_encryptions == n
+        chunk0_after = service.get_clerking_job_chunk(clerk.agent, job_after.id, 0)
+        assert [e.to_json() for e in chunk0_after] == [
+            e.to_json() for e in chunk0_before
+        ]
+
+        for c in clerk_clients:
+            c.run_chores(-1)
+        expected = [
+            sum(v[d] for v in values) % agg.modulus
+            for d in range(agg.vector_dimension)
+        ]
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, expected)
+
+
+@pytest.mark.slow
+def test_pipeline_stress_large_cohort(tmp_path, monkeypatch):
+    """Large-N paged pipeline over REST + sqlite: many chunks through the
+    prefetch thread, exact aggregate at the end, and the pipeline stage
+    telemetry populated."""
+    from sda_tpu import telemetry
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_sqlite_server
+
+    monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", "2048")
+    monkeypatch.setenv("SDA_TELEMETRY", "1")
+    n = 20000
+    with serve_background(new_sqlite_server(str(tmp_path / "sda.db"))) as url:
+        service = SdaHttpClient(url, TokenStore(str(tmp_path / "tokens")))
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, service, n_clerks=2
+        )
+        agg = _new_aggregation(
+            recipient, rkey, AdditiveSharing(share_count=2, modulus=433)
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        participant = new_client(tmp_path / "participant", service)
+        participant.upload_agent()
+        participant.participate_many([[1, 2, 3, 4]] * n, agg.id, chunk_size=512)
+        recipient.end_aggregation(agg.id)
+        for clerk in clerks:
+            job = service.get_clerking_job(clerk.agent, clerk.agent.id)
+            assert job is not None and job.is_paged()
+            assert job.total_encryptions == n
+            clerk.run_chores(-1)
+        expected = [(n * v) % agg.modulus for v in [1, 2, 3, 4]]
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, expected)
+
+        snap = telemetry.snapshot(include_spans=0)
+        stages = {
+            h["labels"].get("stage")
+            for h in snap["histograms"]
+            if h["name"] == "sda_clerk_stage_seconds"
+        }
+        assert {"download", "decrypt", "combine"} <= stages
+        assert any(
+            g["name"] == "sda_clerk_overlap_efficiency" for g in snap["gauges"]
+        )

@@ -90,6 +90,34 @@ def test_ingest_rider_section(tmp_path, capsys):
     assert "fastest" not in out  # no exp rows -> no device recommendation
 
 
+def test_clerking_rider_section(tmp_path, capsys):
+    _write(tmp_path, "clerking-20260805-020000.json",
+           {"metric": "clerking_pipeline",
+            "config": {"n_participants": 6000, "clerks": 2},
+            "configs": {
+                "monolithic": {"encryptions_per_s": 20000, "wall_s": 0.3,
+                               "peak_rss_mib": 86.0, "chunk_size": None,
+                               "overlap_efficiency": None},
+                "chunked_4096": {"encryptions_per_s": 18000, "wall_s": 0.33,
+                                 "peak_rss_mib": 68.4, "chunk_size": 4096,
+                                 "overlap_efficiency": 0.93,
+                                 "vs_monolithic": 0.9}}})
+    _write(tmp_path, "clerking-broken.json", {"note": "no configs"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # clerking rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "clerking-pipeline riders" in out
+    assert "clerking-20260805-020000.json" in out
+    assert "monolithic" in out and "chunked_4096" in out
+    assert "0.93" in out  # overlap efficiency column
+    assert "clerking-broken.json" not in out
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]

@@ -178,7 +178,15 @@ def test_client_trace_id_lands_in_server_store_spans(http_ctx):
     assert store_spans, "no store spans carried the client trace id"
     assert all(s["trace_id"] == "trace-roundtrip-1" for s in store_spans)
     assert any(s["attrs"].get("store") == "mem" for s in store_spans)
-    # the HTTP dispatch span carries it too
+    # the HTTP dispatch span carries it too. It is recorded when the
+    # handler's span block exits — AFTER the response bytes may already
+    # have reached the client — so give the server thread a moment.
+    deadline = time.monotonic() + 2.0
+    while (
+        not telemetry.spans(name="http.request", trace_id="trace-roundtrip-1")
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
     assert telemetry.spans(name="http.request", trace_id="trace-roundtrip-1")
 
 
