@@ -12,8 +12,10 @@ Prometheus scraper would and checks:
 1. every line obeys the text-format 0.0.4 line grammar;
 2. the core series exist with nonzero samples:
    sda_http_requests_total, sda_store_op_seconds, sda_crypto_seals_total,
-   sda_engine_step_seconds, and — via a paged clerking round —
-   sda_clerk_stage_seconds and sda_clerk_overlap_efficiency.
+   sda_engine_step_seconds, — via a paged clerking round —
+   sda_clerk_stage_seconds and sda_clerk_overlap_efficiency, and — via a
+   paged reveal — sda_reveal_stage_seconds and
+   sda_reveal_overlap_efficiency.
 
 Run by ci.sh after the CLI walkthrough: JAX_PLATFORMS=cpu python
 scripts/check_metrics.py. Exit 0 on pass, 1 with a diagnostic on fail.
@@ -50,6 +52,11 @@ REQUIRED_SERIES = [
     # paged-job round drive_workload runs (threshold 0 pages every job)
     "sda_clerk_stage_seconds",
     "sda_clerk_overlap_efficiency",
+    # reveal pipeline: stage histograms + the overlap gauge, lit by the
+    # paged reveal drive_workload finishes the round with (threshold 0
+    # pages every snapshot result)
+    "sda_reveal_stage_seconds",
+    "sda_reveal_overlap_efficiency",
 ]
 
 
@@ -62,7 +69,7 @@ def drive_workload(base_url: str, tmp: str) -> None:
         AdditiveSharing,
         Aggregation,
         AggregationId,
-        NoMasking,
+        FullMasking,
         SodiumEncryptionScheme,
     )
     from sda_tpu.rest import SdaHttpClient, TokenStore
@@ -84,7 +91,8 @@ def drive_workload(base_url: str, tmp: str) -> None:
         modulus=433,
         recipient=recipient.agent.id,
         recipient_key=rkey,
-        masking_scheme=NoMasking(),
+        # Full masking so the reveal's mask decrypt + fold stages light
+        masking_scheme=FullMasking(modulus=433),
         committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
         recipient_encryption_scheme=SodiumEncryptionScheme(),
         committee_encryption_scheme=SodiumEncryptionScheme(),
@@ -101,19 +109,26 @@ def drive_workload(base_url: str, tmp: str) -> None:
     participant.upload_agent()
     participant.participate([1, 2, 3, 4], agg.id)  # seals -> crypto series
 
-    # run the round to completion through the PAGED delivery path so the
-    # clerk pipeline series (download/decrypt/combine histograms + the
-    # overlap-efficiency gauge) appear in the scrape
+    # run the round to completion through the PAGED delivery paths so
+    # both pipelines' series (clerk download/decrypt/combine + reveal
+    # download/decrypt/fold/reconstruct histograms, and both
+    # overlap-efficiency gauges) appear in the scrape
     os.environ["SDA_JOB_PAGE_THRESHOLD"] = "0"
     os.environ["SDA_JOB_CHUNK_SIZE"] = "2"
+    os.environ["SDA_RESULT_PAGE_THRESHOLD"] = "0"
+    os.environ["SDA_RESULT_CHUNK_SIZE"] = "2"
     try:
         recipient.end_aggregation(agg.id)
         for clerk in clerks:
             clerk.run_chores(-1)
         recipient.run_chores(-1)  # recipient may hold a committee seat too
+        out = recipient.reveal_aggregation(agg.id).positive()
+        assert list(out.values) == [1, 2, 3, 4], "workload reveal disagrees"
     finally:
         os.environ.pop("SDA_JOB_PAGE_THRESHOLD", None)
         os.environ.pop("SDA_JOB_CHUNK_SIZE", None)
+        os.environ.pop("SDA_RESULT_PAGE_THRESHOLD", None)
+        os.environ.pop("SDA_RESULT_CHUNK_SIZE", None)
 
 
 def drive_engine() -> None:

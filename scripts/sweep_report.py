@@ -20,6 +20,12 @@ baseline + each paged chunk size) with throughput, the ratio against the
 monolithic baseline from the SAME run, peak clerk RSS, and the measured
 download-overlap efficiency.
 
+Also tabulates the reveal-pipeline rider artifacts
+(``bench-artifacts/reveal-<stamp>.json``, written by bench.py's
+measure_reveal_pipeline) in the same shape: monolithic vs chunked reveal
+per cohort size, with peak recipient RSS and overlap efficiency — the
+evidence that reveal memory stays flat in N.
+
 Usage: python scripts/sweep_report.py [artifact_dir]
 """
 
@@ -151,6 +157,53 @@ def print_clerking(rows) -> None:
         )
 
 
+def load_reveal(artdir: pathlib.Path):
+    """One row per delivery config per reveal-*.json artifact."""
+    rows = []
+    for f in sorted(artdir.glob("reveal-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        configs = d.get("configs") if isinstance(d, dict) else None
+        if not isinstance(configs, dict):
+            continue
+        for tag, cfg in sorted(configs.items()):
+            if not isinstance(cfg, dict) or cfg.get("encryptions_per_s") is None:
+                continue
+            rows.append(
+                {
+                    "artifact": f.name,
+                    "tag": tag,
+                    "n": cfg.get("n_participants"),
+                    "chunk": cfg.get("chunk_size"),
+                    "encs_per_s": cfg.get("encryptions_per_s"),
+                    "vs_mono": cfg.get("vs_monolithic"),
+                    "rss_mib": cfg.get("peak_rss_mib"),
+                    "overlap": cfg.get("overlap_efficiency"),
+                }
+            )
+    return rows
+
+
+def print_reveal(rows) -> None:
+    print("\nreveal-pipeline riders (reveal-*.json):")
+    print(
+        f"{'config':>16} {'n':>7} {'chunk':>6} {'encs/s':>9} {'vs_mono':>8} "
+        f"{'rss_mib':>8} {'overlap':>8}  artifact"
+    )
+    for r in rows:
+        overlap = f"{r['overlap']:.2f}" if r["overlap"] is not None else "-"
+        print(
+            f"{r['tag']:>16} {r['n'] if r['n'] is not None else '-':>7} "
+            f"{r['chunk'] if r['chunk'] is not None else '-':>6} "
+            f"{r['encs_per_s']:>9} "
+            f"{r['vs_mono'] if r['vs_mono'] is not None else '-':>8} "
+            f"{r['rss_mib'] if r['rss_mib'] is not None else '-':>8} "
+            f"{overlap:>8}  {r['artifact']}"
+        )
+
+
 def tag_of(row):
     # prefer the metric line (bench.py records rng/chunk/check since r5,
     # ADVICE r4 #2); filename tag as fallback for pre-r5 artifacts
@@ -180,10 +233,11 @@ def main() -> int:
     rows = load(artdir)
     ingest_rows = load_ingest(artdir)
     clerking_rows = load_clerking(artdir)
-    if not rows and not ingest_rows and not clerking_rows:
+    reveal_rows = load_reveal(artdir)
+    if not rows and not ingest_rows and not clerking_rows and not reveal_rows:
         print(
-            f"no rate-bearing exp-*.json, ingest-*.json, or clerking-*.json "
-            f"artifacts under {artdir}/",
+            f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
+            f"or reveal-*.json artifacts under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -222,6 +276,8 @@ def main() -> int:
         print_ingest(ingest_rows)
     if clerking_rows:
         print_clerking(clerking_rows)
+    if reveal_rows:
+        print_reveal(reveal_rows)
     return 0
 
 

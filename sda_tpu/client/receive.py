@@ -6,16 +6,96 @@ creates one snapshot if none exists, and reveal decrypts + combines masks,
 decrypts clerk results into indexed share vectors, reconstructs, and
 unmasks. ``RecipientOutput.positive()`` lifts truncated-remainder residues
 into [0, m) (receive.rs:8-21).
+
+Large snapshot results arrive PAGED: above ``SDA_RESULT_PAGE_THRESHOLD``
+the server answers ``get_snapshot_result`` with counts only and the
+recipient streams the mask-encryption column and the clerk-result list
+range-by-range. Download and compute overlap in a two-stage pipeline —
+a prefetch thread fetches chunk i+1 while the main thread runs the
+native batched sealed-box open on chunk i and folds the plaintext masks
+into a streaming modular accumulator (``MaskCombiner.accumulator``) —
+so recipient memory stays flat in cohort size and wall time approaches
+max(download, decrypt+fold) instead of their sum. Small results keep the
+legacy bulk wire shape but route through the same accumulator as a
+single chunk, so both paths share one fold semantics (and are
+byte-identical — see tests/test_reveal_chunks.py).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from .. import telemetry
 from ..ops.modular import positive
-from ..protocol import Committee, Snapshot, SnapshotId
+from ..protocol import Committee, SdaError, Snapshot, SnapshotId
+
+#: reveal pipeline stage latency — one histogram per stage; the bench
+#: rider and scripts/check_metrics.py key on this series name
+_STAGE_SERIES = "sda_reveal_stage_seconds"
+_STAGE_HELP = "recipient reveal pipeline stage latency by stage"
+
+
+def _iter_result_chunks(fetch, total: int, what: str, stage_times: dict):
+    """Yield a paged snapshot-result column as decrypt-ready blocks.
+
+    ``fetch(start)`` is the range read (``get_snapshot_result_masks`` or
+    ``get_snapshot_result_clerks``); chunk 0 is fetched synchronously,
+    then a prefetch thread downloads chunk i+1 while the consumer
+    decrypts chunk i — the clerk plane's pipeline (client/clerk.py
+    ``_iter_job_chunks``) applied to the reveal plane. In-flight memory
+    is bounded to two chunks. The range cursor advances by the length
+    the server actually returned, so a server configured with a
+    different chunk size stays in lockstep.
+    """
+    if total <= 0:
+        return
+
+    download_hist = telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="download")
+
+    def timed_fetch(start: int):
+        t0 = time.perf_counter()
+        chunk = fetch(start)
+        dt = time.perf_counter() - t0
+        download_hist.observe(dt)
+        stage_times["download"] += dt
+        if chunk is None:
+            raise SdaError(f"snapshot result {what} disappeared mid-download")
+        if not chunk:
+            raise SdaError(f"snapshot result {what} truncated at {start}/{total}")
+        return chunk
+
+    # the prefetch worker starts with a fresh contextvars context —
+    # rebind the caller's trace id so chunk GETs still carry X-SDA-Trace
+    trace_id = telemetry.current_trace_id()
+
+    def prefetch(start: int, box: list) -> None:
+        if trace_id:
+            telemetry.set_trace_id(trace_id)
+        try:
+            box.append(timed_fetch(start))
+        except BaseException as exc:  # re-raised on the consumer side
+            box.append(exc)
+
+    chunk = timed_fetch(0)
+    start = len(chunk)
+    while True:
+        worker = None
+        box: list = []
+        if start < total:
+            worker = threading.Thread(target=prefetch, args=(start, box), daemon=True)
+            worker.start()
+        yield chunk
+        if worker is None:
+            return
+        worker.join()
+        if isinstance(box[0], BaseException):
+            raise box[0]
+        chunk = box[0]
+        start += len(chunk)
 
 
 @dataclass
@@ -94,7 +174,8 @@ class Receiving:
         ready = [s for s in status.snapshots if s.result_ready]
         if not ready:
             raise ValueError("Aggregation not ready")
-        result = self.service.get_snapshot_result(self.agent, aggregation_id, ready[0].id)
+        snapshot_id = ready[0].id
+        result = self.service.get_snapshot_result(self.agent, aggregation_id, snapshot_id)
         if result is None:
             raise ValueError("Missing aggregation result")
 
@@ -103,38 +184,100 @@ class Receiving:
             aggregation.recipient_key, aggregation.recipient_encryption_scheme
         )
 
-        # decrypt and combine masks
-        if result.recipient_encryptions is None:
+        decrypt_hist = telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="decrypt")
+        fold_hist = telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="fold")
+        stage_times = {"download": 0.0, "decrypt": 0.0, "fold": 0.0, "reconstruct": 0.0}
+        t_wall0 = time.perf_counter()
+
+        # both wire shapes feed one streaming machinery: paged results
+        # arrive as pipelined range reads, legacy bulk results as a
+        # single chunk — fold semantics (and bytes) are identical
+        if result.is_paged():
+            def fetch_masks(start):
+                return self.service.get_snapshot_result_masks(
+                    self.agent, aggregation_id, snapshot_id, start
+                )
+
+            def fetch_clerks(start):
+                return self.service.get_snapshot_result_clerks(
+                    self.agent, aggregation_id, snapshot_id, start
+                )
+
+            mask_chunks = (
+                None
+                if result.mask_encryption_count is None  # snapshot stored no mask
+                else _iter_result_chunks(
+                    fetch_masks, result.mask_encryption_count, "masks", stage_times
+                )
+            )
+            clerk_chunks = _iter_result_chunks(
+                fetch_clerks, result.clerk_result_count, "clerk results", stage_times
+            )
+        else:
+            mask_chunks = (
+                None
+                if result.recipient_encryptions is None
+                else iter([result.recipient_encryptions])
+            )
+            clerk_chunks = iter([result.clerk_encryptions])
+
+        # decrypt + fold masks chunk by chunk: peak memory is one chunk
+        # of ciphertexts (plus the prefetched next) and one combined
+        # partial — never the whole cohort's mask column
+        if mask_chunks is None:
             mask = np.empty(0, dtype=np.int64)
         else:
-            decrypted = decryptor.decrypt_batch(result.recipient_encryptions)
-            mask_combiner = self.crypto.new_mask_combiner(aggregation.masking_scheme)
-            mask = mask_combiner.combine(decrypted)
+            accumulator = self.crypto.new_mask_combiner(
+                aggregation.masking_scheme
+            ).accumulator()
+            for block in mask_chunks:
+                t0 = time.perf_counter()
+                decrypted = decryptor.decrypt_batch(block)
+                dt = time.perf_counter() - t0
+                decrypt_hist.observe(dt)
+                stage_times["decrypt"] += dt
+                t0 = time.perf_counter()
+                accumulator.fold(decrypted)
+                dt = time.perf_counter() - t0
+                fold_hist.observe(dt)
+                stage_times["fold"] += dt
+            mask = accumulator.finish()
 
-        # decrypt clerk results into (committee index, share vector) pairs
+        # stream clerk results, batch-decrypt each block into
+        # (committee index, share vector) pairs
         clerk_positions = {
             clerk: ix for ix, (clerk, _) in enumerate(committee.clerks_and_keys)
         }
         indexed_shares = []
-        for clerking_result in result.clerk_encryptions:
-            if clerking_result.clerk not in clerk_positions:
-                raise ValueError(f"Missing clerk {clerking_result.clerk}")
-            indexed_shares.append(
-                (
-                    clerk_positions[clerking_result.clerk],
-                    decryptor.decrypt(clerking_result.encryption),
-                )
+        for block in clerk_chunks:
+            if not block:
+                continue
+            for clerking_result in block:
+                if clerking_result.clerk not in clerk_positions:
+                    raise ValueError(f"Missing clerk {clerking_result.clerk}")
+            t0 = time.perf_counter()
+            share_vectors = decryptor.decrypt_batch(
+                [cr.encryption for cr in block]
+            )
+            dt = time.perf_counter() - t0
+            decrypt_hist.observe(dt)
+            stage_times["decrypt"] += dt
+            indexed_shares.extend(
+                (clerk_positions[cr.clerk], shares)
+                for cr, shares in zip(block, share_vectors)
             )
 
         if all(len(shares) == 0 for _, shares in indexed_shares):
             # an empty snapshot cut (every clerk combined zero
             # participations): the aggregate over the empty set is the
             # zero vector — don't run the reconstructor on empty batches
+            self._record_reveal_pipeline(stage_times, time.perf_counter() - t_wall0)
             return RecipientOutput(
                 modulus=aggregation.modulus,
                 values=np.zeros(aggregation.vector_dimension, dtype=np.int64),
             )
 
+        t0 = time.perf_counter()
         reconstructor = self.crypto.new_secret_reconstructor(
             aggregation.committee_sharing_scheme, aggregation.vector_dimension
         )
@@ -142,4 +285,27 @@ class Receiving:
 
         unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
         output = unmasker.unmask(mask, masked_output)
+        dt = time.perf_counter() - t0
+        telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="reconstruct").observe(dt)
+        stage_times["reconstruct"] += dt
+        self._record_reveal_pipeline(stage_times, time.perf_counter() - t_wall0)
         return RecipientOutput(modulus=aggregation.modulus, values=output)
+
+    @staticmethod
+    def _record_reveal_pipeline(stage_times: dict, t_wall: float) -> None:
+        """Gauge how much download cost the prefetch pipeline hid behind
+        compute: 1.0 = fully overlapped, 0.0 = fully serial. Only paged
+        reveals accumulate download time (bulk results ride the one
+        ``get_snapshot_result`` call), so the gauge tracks paged reveals.
+        """
+        if stage_times["download"] <= 0:
+            return
+        compute = (
+            stage_times["decrypt"] + stage_times["fold"] + stage_times["reconstruct"]
+        )
+        overlap = (stage_times["download"] + compute - t_wall) / stage_times["download"]
+        telemetry.gauge(
+            "sda_reveal_overlap_efficiency",
+            "fraction of download time hidden behind decrypt+fold by the "
+            "paged-result reveal pipeline (last reveal)",
+        ).set(min(1.0, max(0.0, overlap)))

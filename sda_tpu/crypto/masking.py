@@ -29,6 +29,48 @@ class MaskCombiner:
         """Combine all participants' uploaded masks into one."""
         raise NotImplementedError
 
+    def accumulator(self) -> "MaskAccumulator":
+        """Streaming equivalent of ``combine``: fold the cohort's masks
+        chunk by chunk, holding one chunk plus one combined partial at a
+        time — the ``sumfirst`` discipline (parallel/sumfirst.py) applied
+        to the reveal plane, so recipient memory stays flat in cohort
+        size. ``finish()`` is byte-identical to the monolithic
+        ``combine`` over the concatenated chunks (see MaskAccumulator)."""
+        return MaskAccumulator(self)
+
+
+class MaskAccumulator:
+    """Chunk-by-chunk mask folding with an exactness contract: every
+    per-chunk partial (``combine``) and every pairwise fold below is a
+    CANONICAL residue in ``[0, m)``, and modular addition of canonical
+    representatives is associative — so the folded result is
+    byte-identical to the monolithic combine REGARDLESS of chunk
+    boundaries (asserted across the full matrix in
+    tests/test_reveal_chunks.py). The pairwise fold adds in uint64 (two
+    canonical values each < m sum below 2**64 for any m <= 2**63 —
+    the same width discipline as ``chacha_combine``'s host path)."""
+
+    def __init__(self, combiner: MaskCombiner):
+        self._combiner = combiner
+        self._acc: np.ndarray | None = None
+
+    def fold(self, masks: list) -> None:
+        if not masks:
+            return
+        partial = self._combiner.combine(masks)
+        if self._acc is None or self._acc.size == 0:
+            self._acc = partial
+        elif partial.size:
+            total = self._acc.astype(np.uint64) + partial.astype(np.uint64)
+            self._acc = (total % np.uint64(self._combiner.modulus)).astype(np.int64)
+
+    def finish(self) -> np.ndarray:
+        if self._acc is None:
+            # no chunks at all: each scheme's own empty-cohort shape
+            # (NoMasking/Full: empty vector; ChaCha: zeros(dimension))
+            return self._combiner.combine([])
+        return self._acc
+
 
 class SecretUnmasker:
     def unmask(self, mask: np.ndarray, masked: np.ndarray) -> np.ndarray:

@@ -138,4 +138,32 @@ class SdaService(abc.ABC):
 
     @abc.abstractmethod
     def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
-        """Fetch the collected clerk results + mask blob for a snapshot."""
+        """Fetch the collected clerk results + mask blob for a snapshot.
+
+        Results above the server's paging threshold come back as metadata
+        (``SnapshotResult.is_paged()``): payload lists empty,
+        ``mask_encryption_count``/``clerk_result_count``/``chunk_size``
+        set, both payloads fetched range-by-range via
+        ``get_snapshot_result_masks`` / ``get_snapshot_result_clerks``."""
+
+    def get_snapshot_result_masks(self, caller, aggregation_id, snapshot_id, start: int):
+        """Fetch one recipient-mask-encryption range
+        ``[start, start+server_chunk)`` of a paged snapshot result;
+        returns list[Encryption] (empty past the end), or None for a
+        snapshot that doesn't exist, doesn't belong to the aggregation,
+        or stored no mask. Same compatibility shim rationale as
+        ``get_clerking_job_chunk``: a binding predating paged delivery
+        never hands out a paged result, so reaching this default means a
+        binding/version mismatch."""
+        raise NotImplementedError(
+            "this SdaService binding does not support paged snapshot results"
+        )
+
+    def get_snapshot_result_clerks(self, caller, aggregation_id, snapshot_id, start: int):
+        """Fetch one clerk-result range ``[start, start+server_chunk)``
+        of a paged snapshot result, ordered by job id; returns
+        list[ClerkingResult] (empty past the end), or None for a snapshot
+        that doesn't exist or doesn't belong to the aggregation."""
+        raise NotImplementedError(
+            "this SdaService binding does not support paged snapshot results"
+        )

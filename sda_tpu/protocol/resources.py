@@ -370,15 +370,34 @@ class AggregationStatus:
 
 @dataclass
 class SnapshotResult:
-    """Result of a snapshot, ready for reconstruction (resources.rs:179-188)."""
+    """Result of a snapshot, ready for reconstruction (resources.rs:179-188).
+
+    Results above the server's paging threshold are DELIVERED as metadata:
+    ``clerk_encryptions`` empty, ``recipient_encryptions`` None, and the
+    three paging fields set; the recipient then streams both payloads
+    range-by-range via
+    ``GET .../snapshots/{id}/result/masks/{start}`` and
+    ``GET .../snapshots/{id}/result/clerks/{start}``. Small results keep
+    the original four-key wire shape (paging fields are emitted only when
+    set), so pre-paging clients and transcripts stay byte compatible.
+    ``mask_encryption_count`` is None in a paged result iff the snapshot
+    stored no recipient mask (NoMasking) — mirroring the legacy
+    ``recipient_encryptions`` None/list distinction.
+    """
 
     snapshot: SnapshotId
     number_of_participations: int
     clerk_encryptions: list  # list[ClerkingResult]
     recipient_encryptions: Optional[list]  # Optional[list[Encryption]]
+    mask_encryption_count: Optional[int] = None  # paged delivery only
+    clerk_result_count: Optional[int] = None  # paged delivery only
+    chunk_size: Optional[int] = None  # server's suggested fetch range
+
+    def is_paged(self) -> bool:
+        return self.clerk_result_count is not None
 
     def to_json(self):
-        return {
+        obj = {
             "snapshot": self.snapshot.to_json(),
             "number_of_participations": self.number_of_participations,
             "clerk_encryptions": [c.to_json() for c in self.clerk_encryptions],
@@ -386,6 +405,13 @@ class SnapshotResult:
                 self.recipient_encryptions, lambda es: [e.to_json() for e in es]
             ),
         }
+        if self.mask_encryption_count is not None:
+            obj["mask_encryption_count"] = self.mask_encryption_count
+        if self.clerk_result_count is not None:
+            obj["clerk_result_count"] = self.clerk_result_count
+        if self.chunk_size is not None:
+            obj["chunk_size"] = self.chunk_size
+        return obj
 
     @classmethod
     def from_json(cls, obj):
@@ -397,6 +423,9 @@ class SnapshotResult:
             recipient_encryptions=None
             if recipient is None
             else [Encryption.from_json(e) for e in recipient],
+            mask_encryption_count=_opt(obj.get("mask_encryption_count"), int),
+            clerk_result_count=_opt(obj.get("clerk_result_count"), int),
+            chunk_size=_opt(obj.get("chunk_size"), int),
         )
 
 

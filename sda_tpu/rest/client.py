@@ -198,6 +198,28 @@ class SdaHttpClient(SdaService):
         )
         return None if obj is None else SnapshotResult.from_json(obj)
 
+    def get_snapshot_result_masks(self, caller, aggregation_id, snapshot_id, start):
+        from ..protocol import Encryption
+
+        obj = self._request(
+            "GET",
+            f"/v1/aggregations/{quote(str(aggregation_id))}/snapshots/"
+            f"{quote(str(snapshot_id))}/result/masks/{int(start)}",
+            caller,
+        )
+        return None if obj is None else [Encryption.from_json(e) for e in obj]
+
+    def get_snapshot_result_clerks(self, caller, aggregation_id, snapshot_id, start):
+        from ..protocol import ClerkingResult
+
+        obj = self._request(
+            "GET",
+            f"/v1/aggregations/{quote(str(aggregation_id))}/snapshots/"
+            f"{quote(str(snapshot_id))}/result/clerks/{int(start)}",
+            caller,
+        )
+        return None if obj is None else [ClerkingResult.from_json(c) for c in obj]
+
     # -- participation ------------------------------------------------------
 
     def create_participation(self, caller, participation) -> None:

@@ -26,6 +26,8 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
                               (additive; one ciphertext range of a paged job)
     POST   /v1/aggregations/implied/jobs/{ClerkingJobId}/result
     GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result
+    GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result/masks/{start}
+    GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result/clerks/{start}
     GET    /v1/metrics        (additive; unauthenticated Prometheus text)
     GET    /v1/metrics.json   (additive; unauthenticated telemetry snapshot)
 
@@ -415,6 +417,37 @@ class _Handler(BaseHTTPRequestHandler):
                 self._caller(), self._read(ClerkingResult.from_json)
             )
             self._send(201)
+            return True
+
+        if method == "GET" and (
+            match := m(rf"/v1/aggregations/({_UUID})/snapshots/({_UUID})/result/masks/(\d+)")
+        ):
+            # one recipient-mask-encryption range of a paged snapshot
+            # result (recipient-only by ACL). Response: bare JSON array.
+            chunk = svc.get_snapshot_result_masks(
+                self._caller(),
+                AggregationId(match.group(1)),
+                SnapshotId(match.group(2)),
+                int(match.group(3)),
+            )
+            self._send_json_option(
+                None if chunk is None else [e.to_json() for e in chunk]
+            )
+            return True
+
+        if method == "GET" and (
+            match := m(rf"/v1/aggregations/({_UUID})/snapshots/({_UUID})/result/clerks/(\d+)")
+        ):
+            # one clerk-result range, in the canonical job-id order
+            chunk = svc.get_snapshot_result_clerks(
+                self._caller(),
+                AggregationId(match.group(1)),
+                SnapshotId(match.group(2)),
+                int(match.group(3)),
+            )
+            self._send_json_option(
+                None if chunk is None else [c.to_json() for c in chunk]
+            )
             return True
 
         if method == "GET" and (

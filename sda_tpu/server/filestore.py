@@ -47,6 +47,7 @@ from .stores import (
     ClerkingJobsStore,
     job_chunk_size,
     job_page_threshold,
+    result_page_threshold,
     split_small_column,
 )
 
@@ -162,6 +163,9 @@ class FileAggregationsStore(AggregationsStore):
         for snap_id in self._snapshots(aggregation_id).list_ids():
             self.members.delete(snap_id)
             self.masks.delete(snap_id)
+            for path in self._mask_paths(snap_id):
+                if os.path.exists(path):
+                    os.unlink(path)
         self.aggregations.delete(aggregation_id)
         self.committees.delete(aggregation_id)
         for sub in ("participations", "snapshots"):
@@ -345,14 +349,92 @@ class FileAggregationsStore(AggregationsStore):
 
         return (column_chunks(ix) for ix in range(clerks_number))
 
+    # -- snapshot masks ------------------------------------------------------
+    # Two layouts, mirroring FileClerkingJobsStore's columns: small masks
+    # stay a single JSON list in the masks JsonDir; masks above
+    # result_page_threshold() are EXTERNALIZED — the JsonDir payload
+    # becomes the marker ``{"externalized": n}`` and the encryptions live
+    # in ``mask_columns/<snapshot>.jsonl`` with an n+1 little-endian
+    # uint64 byte-offset sidecar, so a range read is two seeks, never a
+    # blob parse. Layout is decided at WRITE time; the wire shape is
+    # decided per call in the service, so either layout serves both.
+
+    def _mask_paths(self, snapshot_id):
+        d = os.path.join(self.root, "mask_columns")
+        os.makedirs(d, exist_ok=True)
+        return (
+            os.path.join(d, f"{snapshot_id}.jsonl"),
+            os.path.join(d, f"{snapshot_id}.idx"),
+        )
+
+    def _read_mask_range(self, snapshot_id, start: int, end: int) -> list:
+        if end <= start:
+            return []
+        data_path, idx_path = self._mask_paths(snapshot_id)
+        with open(idx_path, "rb") as xf:
+            xf.seek(start * 8)
+            raw = xf.read((end - start + 1) * 8)
+        offs = struct.unpack(f"<{len(raw) // 8}Q", raw)
+        if len(offs) < 2:
+            return []
+        with open(data_path, "rb") as df:
+            df.seek(offs[0])
+            blob = df.read(offs[-1] - offs[0])
+        return [Encryption.from_json(json.loads(line)) for line in blob.splitlines()]
+
     def create_snapshot_mask(self, snapshot_id, mask) -> None:
-        self.masks.put(snapshot_id, [e.to_json() for e in mask])
+        mask = list(mask)
+        if len(mask) <= result_page_threshold():
+            self.masks.put(snapshot_id, [e.to_json() for e in mask])
+            return
+        # externalized: column files land atomically first, the marker —
+        # the blob's visibility point — last, so a crash mid-write leaves
+        # the mask absent and the snapshot pipeline's retry rewrites it
+        data_path, idx_path = self._mask_paths(snapshot_id)
+        tmp_data, tmp_idx = data_path + ".tmp", idx_path + ".tmp"
+        try:
+            with open(tmp_data, "wb") as df, open(tmp_idx, "wb") as xf:
+                off = 0
+                xf.write(struct.pack("<Q", 0))
+                for e in mask:
+                    line = json.dumps(e.to_json()).encode("utf-8") + b"\n"
+                    df.write(line)
+                    off += len(line)
+                    xf.write(struct.pack("<Q", off))
+            os.replace(tmp_data, data_path)
+            os.replace(tmp_idx, idx_path)
+        finally:
+            for tmp in (tmp_data, tmp_idx):
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        self.masks.put(snapshot_id, {"externalized": len(mask)})
 
     def get_snapshot_mask(self, snapshot_id):
-        from ..protocol import Encryption
-
         payload = self.masks.get(snapshot_id)
-        return None if payload is None else [Encryption.from_json(e) for e in payload]
+        if payload is None:
+            return None
+        if isinstance(payload, dict):
+            return self._read_mask_range(snapshot_id, 0, int(payload["externalized"]))
+        return [Encryption.from_json(e) for e in payload]
+
+    def count_snapshot_mask(self, snapshot_id):
+        payload = self.masks.get(snapshot_id)
+        if payload is None:
+            return None
+        if isinstance(payload, dict):
+            return int(payload["externalized"])
+        return len(payload)
+
+    def get_snapshot_mask_range(self, snapshot_id, start, count):
+        payload = self.masks.get(snapshot_id)
+        if payload is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        if isinstance(payload, dict):
+            end = min(start + count, int(payload["externalized"]))
+            return self._read_mask_range(snapshot_id, start, end)
+        return [Encryption.from_json(e) for e in payload[start : start + count]]
 
 
 class FileClerkingJobsStore(ClerkingJobsStore):
@@ -549,6 +631,23 @@ class FileClerkingJobsStore(ClerkingJobsStore):
         results = self._results(snapshot_id)
         out = []
         for job_id in results.list_ids():
+            payload = results.get(job_id)
+            if payload is None:
+                raise ServerError("inconsistent storage")
+            out.append(ClerkingResult.from_json(payload))
+        return out
+
+    def count_results(self, snapshot_id) -> int:
+        return len(self._results(snapshot_id).list_ids())
+
+    def get_results_range(self, snapshot_id, start, count) -> list:
+        # file-per-result: the range is an id-list slice, reading only
+        # the requested files (list_ids is already the canonical order)
+        if start < 0 or count < 0:
+            return []
+        results = self._results(snapshot_id)
+        out = []
+        for job_id in results.list_ids()[start : start + count]:
             payload = results.get(job_id)
             if payload is None:
                 raise ServerError("inconsistent storage")

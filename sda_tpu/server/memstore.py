@@ -215,6 +215,20 @@ class MemAggregationsStore(AggregationsStore):
         with self._lock:
             return self._snapshot_masks.get(snapshot_id)
 
+    def count_snapshot_mask(self, snapshot_id):
+        with self._lock:
+            mask = self._snapshot_masks.get(snapshot_id)
+            return None if mask is None else len(mask)
+
+    def get_snapshot_mask_range(self, snapshot_id, start, count):
+        with self._lock:
+            mask = self._snapshot_masks.get(snapshot_id)
+            if mask is None:
+                return None
+            if start < 0 or count < 0:
+                return []
+            return mask[start : start + count]
+
 
 class MemClerkingJobsStore(ClerkingJobsStore):
     def __init__(self):
@@ -285,3 +299,15 @@ class MemClerkingJobsStore(ClerkingJobsStore):
         with self._lock:
             table = self._results.get(snapshot_id, {})
             return [table[job_id] for job_id in sorted(table.keys(), key=str)]
+
+    def count_results(self, snapshot_id) -> int:
+        with self._lock:
+            return len(self._results.get(snapshot_id, {}))
+
+    def get_results_range(self, snapshot_id, start, count) -> list:
+        if start < 0 or count < 0:
+            return []
+        with self._lock:
+            table = self._results.get(snapshot_id, {})
+            ordered = sorted(table.keys(), key=str)[start : start + count]
+            return [table[job_id] for job_id in ordered]

@@ -314,17 +314,45 @@ class SdaServer:
         # aggregation/snapshot spoofing", server.rs:324; fixed here).
         if self.aggregation_store.get_snapshot(aggregation_id, snapshot_id) is None:
             return None
+        number_of_participations = self.aggregation_store.count_participations_snapshot(
+            aggregation_id, snapshot_id
+        )
+        # wire shape decided per CALL from the current threshold (the
+        # stored layout was decided at write time; either serves both):
+        # above it, answer metadata only and let the recipient stream the
+        # two payloads through the range routes
+        mask_count = self.aggregation_store.count_snapshot_mask(snapshot_id)
+        clerk_count = self.clerking_job_store.count_results(snapshot_id)
+        if (mask_count or 0) + clerk_count > stores.result_page_threshold():
+            return SnapshotResult(
+                snapshot=snapshot_id,
+                number_of_participations=number_of_participations,
+                clerk_encryptions=[],
+                recipient_encryptions=None,
+                mask_encryption_count=mask_count,
+                clerk_result_count=clerk_count,
+                chunk_size=stores.result_chunk_size(),
+            )
         # one bulk read (backends: single query/scan) — the old
         # list_results + get_result-per-job loop was an N+1
         results = self.clerking_job_store.get_results(snapshot_id)
         return SnapshotResult(
             snapshot=snapshot_id,
-            number_of_participations=self.aggregation_store.count_participations_snapshot(
-                aggregation_id, snapshot_id
-            ),
+            number_of_participations=number_of_participations,
             clerk_encryptions=results,
             recipient_encryptions=self.aggregation_store.get_snapshot_mask(snapshot_id),
         )
+
+    def get_snapshot_result_masks(self, aggregation_id, snapshot_id, start, count):
+        # same anti-spoofing gate as get_snapshot_result
+        if self.aggregation_store.get_snapshot(aggregation_id, snapshot_id) is None:
+            return None
+        return self.aggregation_store.get_snapshot_mask_range(snapshot_id, start, count)
+
+    def get_snapshot_result_clerks(self, aggregation_id, snapshot_id, start, count):
+        if self.aggregation_store.get_snapshot(aggregation_id, snapshot_id) is None:
+            return None
+        return self.clerking_job_store.get_results_range(snapshot_id, start, count)
 
     # -- auth ----------------------------------------------------------------
 
@@ -470,6 +498,20 @@ class SdaServerService(SdaService):
     def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
         self._acl_recipient(caller, aggregation_id)
         return self.server.get_snapshot_result(aggregation_id, snapshot_id)
+
+    def get_snapshot_result_masks(self, caller, aggregation_id, snapshot_id, start):
+        self._acl_recipient(caller, aggregation_id)
+        count = stores.result_chunk_size()
+        return self.server.get_snapshot_result_masks(
+            aggregation_id, snapshot_id, start, count
+        )
+
+    def get_snapshot_result_clerks(self, caller, aggregation_id, snapshot_id, start):
+        self._acl_recipient(caller, aggregation_id)
+        count = stores.result_chunk_size()
+        return self.server.get_snapshot_result_clerks(
+            aggregation_id, snapshot_id, start, count
+        )
 
     # -- participation ---------------------------------------------------------
 

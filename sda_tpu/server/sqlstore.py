@@ -61,6 +61,7 @@ from .stores import (
     ClerkingJobsStore,
     job_chunk_size,
     job_page_threshold,
+    result_page_threshold,
     split_small_column,
 )
 
@@ -86,6 +87,9 @@ CREATE TABLE IF NOT EXISTS snapshot_members (
     snapshot TEXT NOT NULL, ord INTEGER NOT NULL, participation TEXT NOT NULL,
     PRIMARY KEY (snapshot, ord));
 CREATE TABLE IF NOT EXISTS snapshot_masks (snapshot TEXT PRIMARY KEY, body TEXT NOT NULL);
+CREATE TABLE IF NOT EXISTS mask_encs (
+    snapshot TEXT NOT NULL, pos INTEGER NOT NULL, body TEXT NOT NULL,
+    PRIMARY KEY (snapshot, pos)) WITHOUT ROWID;
 CREATE TABLE IF NOT EXISTS jobs (
     id TEXT PRIMARY KEY, clerk TEXT NOT NULL, snapshot TEXT NOT NULL,
     done INTEGER NOT NULL DEFAULT 0, body TEXT NOT NULL);
@@ -351,6 +355,7 @@ class SqliteAggregationsStore(AggregationsStore):
             for s in snaps:
                 conn.execute("DELETE FROM snapshot_members WHERE snapshot = ?", (s,))
                 conn.execute("DELETE FROM snapshot_masks WHERE snapshot = ?", (s,))
+                conn.execute("DELETE FROM mask_encs WHERE snapshot = ?", (s,))
             conn.execute("DELETE FROM snapshots WHERE aggregation = ?", (a,))
             conn.execute("DELETE FROM participations WHERE aggregation = ?", (a,))
             conn.execute("DELETE FROM committees WHERE aggregation = ?", (a,))
@@ -611,20 +616,82 @@ class SqliteAggregationsStore(AggregationsStore):
 
         return (column_chunks(ix) for ix in range(clerks_number))
 
-    def create_snapshot_mask(self, snapshot_id, mask: list) -> None:
-        self.db.execute(
-            "INSERT INTO snapshot_masks (snapshot, body) VALUES (?, ?) "
-            "ON CONFLICT(snapshot) DO UPDATE SET body = excluded.body",
-            (str(snapshot_id), json.dumps([e.to_json() for e in mask])),
-        )
+    # -- snapshot masks ------------------------------------------------------
+    # Two layouts, mirroring job_encs: small masks stay one JSON blob in
+    # snapshot_masks.body; masks above result_page_threshold() are
+    # EXTERNALIZED — the blob becomes the marker ``{"externalized": n}``
+    # and the encryptions live as one ``mask_encs`` row per ciphertext,
+    # keyed (snapshot, pos), so a range read is an indexed scan. Layout
+    # is decided at write time; the wire shape per call in the service.
 
-    def get_snapshot_mask(self, snapshot_id):
+    def create_snapshot_mask(self, snapshot_id, mask: list) -> None:
+        mask = list(mask)
+        s = str(snapshot_id)
+        with self.db.transaction() as conn:
+            # stale rows from a different-threshold rewrite must not
+            # survive a layout switch (the snapshot retry path overwrites)
+            conn.execute("DELETE FROM mask_encs WHERE snapshot = ?", (s,))
+            if len(mask) <= result_page_threshold():
+                body = json.dumps([e.to_json() for e in mask])
+            else:
+                conn.executemany(
+                    "INSERT INTO mask_encs (snapshot, pos, body) VALUES (?, ?, ?)",
+                    (
+                        (s, pos, json.dumps(e.to_json()))
+                        for pos, e in enumerate(mask)
+                    ),
+                )
+                body = json.dumps({"externalized": len(mask)})
+            conn.execute(
+                "INSERT INTO snapshot_masks (snapshot, body) VALUES (?, ?) "
+                "ON CONFLICT(snapshot) DO UPDATE SET body = excluded.body",
+                (s, body),
+            )
+
+    def _mask_marker(self, snapshot_id):
+        """(payload, total) — payload is the parsed blob (list for the
+        inline layout, dict marker for externalized), total its length."""
         row = self.db.query_one(
             "SELECT body FROM snapshot_masks WHERE snapshot = ?", (str(snapshot_id),)
         )
         if row is None:
+            return None, None
+        payload = json.loads(row[0])
+        if isinstance(payload, dict):
+            return payload, int(payload["externalized"])
+        return payload, len(payload)
+
+    def get_snapshot_mask(self, snapshot_id):
+        payload, total = self._mask_marker(snapshot_id)
+        if payload is None:
             return None
-        return [Encryption.from_json(e) for e in json.loads(row[0])]
+        if isinstance(payload, dict):
+            return self._read_mask_range(snapshot_id, 0, total)
+        return [Encryption.from_json(e) for e in payload]
+
+    def count_snapshot_mask(self, snapshot_id):
+        _, total = self._mask_marker(snapshot_id)
+        return total
+
+    def get_snapshot_mask_range(self, snapshot_id, start, count):
+        payload, total = self._mask_marker(snapshot_id)
+        if payload is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        if isinstance(payload, dict):
+            return self._read_mask_range(snapshot_id, start, min(start + count, total))
+        return [Encryption.from_json(e) for e in payload[start : start + count]]
+
+    def _read_mask_range(self, snapshot_id, start: int, end: int) -> list:
+        if end <= start:
+            return []
+        rows = self.db.query_all(
+            "SELECT body FROM mask_encs WHERE snapshot = ? AND pos >= ? AND pos < ? "
+            "ORDER BY pos",
+            (str(snapshot_id), start, end),
+        )
+        return [Encryption.from_json(json.loads(r[0])) for r in rows]
 
 
 class SqliteClerkingJobsStore(ClerkingJobsStore):
@@ -829,5 +896,21 @@ class SqliteClerkingJobsStore(ClerkingJobsStore):
         rows = self.db.query_all(
             "SELECT body FROM results WHERE snapshot = ? ORDER BY job",
             (str(snapshot_id),),
+        )
+        return [ClerkingResult.from_json(json.loads(r[0])) for r in rows]
+
+    def count_results(self, snapshot_id) -> int:
+        row = self.db.query_one(
+            "SELECT COUNT(*) FROM results WHERE snapshot = ?", (str(snapshot_id),)
+        )
+        return int(row[0])
+
+    def get_results_range(self, snapshot_id, start, count) -> list:
+        if start < 0 or count < 0:
+            return []
+        rows = self.db.query_all(
+            "SELECT body FROM results WHERE snapshot = ? ORDER BY job "
+            "LIMIT ? OFFSET ?",
+            (str(snapshot_id), count, start),
         )
         return [ClerkingResult.from_json(json.loads(r[0])) for r in rows]

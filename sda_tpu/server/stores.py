@@ -38,6 +38,20 @@ def job_chunk_size() -> int:
     return max(1, int(os.environ.get("SDA_JOB_CHUNK_SIZE", "4096")))
 
 
+def result_page_threshold() -> int:
+    """Payload-item count (mask encryptions + clerk results) above which
+    ``get_snapshot_result`` delivers paged metadata instead of the
+    monolithic body. Read per call, like ``job_page_threshold``; <= 0
+    pages every result."""
+    return int(os.environ.get("SDA_RESULT_PAGE_THRESHOLD", "8192"))
+
+
+def result_chunk_size() -> int:
+    """Server-suggested range length for paged snapshot-result delivery.
+    Clamped to >= 1."""
+    return max(1, int(os.environ.get("SDA_RESULT_CHUNK_SIZE", "4096")))
+
+
 def split_small_column(chunks, threshold: int):
     """Consume ``chunks`` just far enough to learn whether the column
     fits within ``threshold`` ciphertexts. Returns ``(column, None)``
@@ -255,6 +269,28 @@ class AggregationsStore(BaseStore):
     @abc.abstractmethod
     def get_snapshot_mask(self, snapshot_id): ...
 
+    def count_snapshot_mask(self, snapshot_id) -> Optional[int]:
+        """Length of the stored recipient-mask blob, or None when the
+        snapshot stored no mask — the paged-delivery decision input.
+        Backends with an externalized mask layout override to answer from
+        metadata without materializing the blob."""
+        mask = self.get_snapshot_mask(snapshot_id)
+        return None if mask is None else len(mask)
+
+    def get_snapshot_mask_range(self, snapshot_id, start: int, count: int) -> Optional[list]:
+        """Mask encryptions ``[start, start+count)`` in stored order, or
+        None when no mask exists. Ranges past the end return the
+        (possibly empty) tail, like ``get_clerking_job_chunk``. Backends
+        override to read ONLY the requested range (sqlite: indexed
+        position rows; file store: byte-offset seek); this default slices
+        the materialized blob for in-memory layouts."""
+        mask = self.get_snapshot_mask(snapshot_id)
+        if mask is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        return mask[start : start + count]
+
 
 class ClerkingJobsStore(BaseStore):
     @abc.abstractmethod
@@ -326,3 +362,20 @@ class ClerkingJobsStore(BaseStore):
                 raise ServerError("inconsistent storage")
             results.append(result)
         return results
+
+    def count_results(self, snapshot_id) -> int:
+        """Number of posted ClerkingResults for the snapshot — the other
+        paged-delivery decision input. Backends override with an indexed
+        COUNT where one exists."""
+        return len(self.list_results(snapshot_id))
+
+    def get_results_range(self, snapshot_id, start: int, count: int) -> list:
+        """ClerkingResults ``[start, start+count)`` in ``get_results``
+        order (sorted by str(job_id) — the canonical cross-backend order,
+        so a paged reader sees exactly the monolithic sequence). Ranges
+        past the end return the (possibly empty) tail. Committee results
+        are small next to mask columns, but paging them through the same
+        discipline keeps one reveal-side code path."""
+        if start < 0 or count < 0:
+            return []
+        return self.get_results(snapshot_id)[start : start + count]

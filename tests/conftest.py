@@ -30,3 +30,88 @@ def pytest_configure(config):
         "slow: long-running stress tests, excluded from the tier-1 "
         "`-m 'not slow'` run",
     )
+
+
+# -- shard_map capability probe ----------------------------------------------
+#
+# The sharded-mesh tests call ``jax.shard_map`` exactly as the engine does
+# (parallel/engine.py, parallel/multihost.py, parallel/sumfirst.py). Some
+# environments ship a jax whose top-level ``shard_map`` is absent or broken;
+# there the whole sharded tier fails on an AttributeError before testing any
+# of OUR logic. Probe the capability once and skip exactly the tests that
+# need it, with the probe's own failure as the reason — environments where
+# the mesh works run the full tier unweakened (the probe executes a real
+# 8-device shard_map, so a subtly broken mesh also surfaces as a skip
+# reason, not a cryptic collection of failures).
+
+#: (file basename, test base name) pairs that require a working
+#: ``jax.shard_map``; base names are exact (parametrized ids stripped), so
+#: non-mesh neighbors (e.g. test_sharded_sum_first_rejects_nondivisible_dim,
+#: which asserts a pre-mesh ValueError) keep running everywhere
+_SHARD_MAP_TESTS = {
+    ("test_engine_fuzz.py", "test_sharded_random_shapes"),
+    ("test_engine_fuzz.py", "test_sharded_wide_random_shapes"),
+    ("test_multihost.py", "test_hierarchical_sum_matches_plaintext"),
+    ("test_multihost.py", "test_hierarchical_sum_with_dim_axis"),
+    ("test_multihost.py", "test_hierarchical_sum_generated_params"),
+    ("test_multihost.py", "test_fold_mesh_axes_distinct_per_device"),
+    ("test_multihost.py", "test_hierarchical_wide_limb_accumulators"),
+    ("test_multihost.py", "test_graft_entry_dryrun_all_fabrics"),
+    ("test_multihost.py", "test_two_process_distributed_round"),
+    ("test_parallel_engine.py", "test_sharded_clerk_sums_on_mesh"),
+    ("test_parallel_engine.py", "test_all_to_all_clerk_sharded_variant"),
+    ("test_parallel_engine.py", "test_sharded_matches_engine_across_mesh_shapes"),
+    ("test_parallel_engine.py", "test_sharded_sum_first_fabric"),
+    ("test_parallel_engine.py", "test_sharded_sum_first_wide_modulus"),
+    ("test_wide_modulus.py", "test_sharded_wide_limb_accumulators"),
+}
+
+_shard_map_failure: str | None = None
+_shard_map_probed = False
+
+
+def _probe_shard_map() -> str | None:
+    """None if ``jax.shard_map`` works on the virtual 8-device mesh;
+    otherwise a short failure string for the skip reason. Probed lazily
+    (first collected mesh test) and cached for the session."""
+    global _shard_map_failure, _shard_map_probed
+    if _shard_map_probed:
+        return _shard_map_failure
+    _shard_map_probed = True
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        devices = np.array(jax.devices()[:8])
+        with Mesh(devices, ("x",)):
+            out = jax.shard_map(
+                lambda v: v + 1,
+                mesh=Mesh(devices, ("x",)),
+                in_specs=P("x"),
+                out_specs=P("x"),
+            )(jnp.zeros(len(devices), dtype=jnp.int32))
+        assert int(np.asarray(out)[0]) == 1
+        _shard_map_failure = None
+    except Exception as exc:  # noqa: BLE001 — any failure means "can't mesh"
+        _shard_map_failure = f"{type(exc).__name__}: {exc}"
+    return _shard_map_failure
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        base_name = item.name.split("[", 1)[0]
+        key = (os.path.basename(str(item.fspath)), base_name)
+        if key not in _SHARD_MAP_TESTS:
+            continue
+        failure = _probe_shard_map()
+        if failure is None:
+            return  # mesh works: run the whole sharded tier unweakened
+        item.add_marker(
+            pytest.mark.skip(
+                reason=f"jax.shard_map unavailable in this environment "
+                f"({failure}); the sharded-mesh tier needs it"
+            )
+        )

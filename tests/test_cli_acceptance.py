@@ -278,14 +278,16 @@ def test_bench_probe_retries_within_deadline():
     assert "retrying" in out.stderr
 
 
-def test_bench_sigkill_mid_retry_leaves_parseable_tail():
+def test_bench_sigkill_mid_retry_leaves_parseable_tail(tmp_path):
     """The round-5 failure shape the wedge-proofing targets: the driver
     SIGKILLs bench while the probe retry loop is still sleeping toward
     its next attempt. The interim error line emitted after the FIRST
     failed probe (refreshed every retry) must already be on stdout, so
     the captured output's last line parses as an error-tagged metric
     line carrying the attempt schedule — even though bench never reached
-    its own give-up emission."""
+    its own give-up emission. The same line must ALSO be banked on disk
+    (SDA_BENCH_ERROR_FILE, atomic replace): a driver that discards the
+    pipe still finds a complete, current error line post-mortem."""
     import json
     import signal
     import sys
@@ -293,6 +295,8 @@ def test_bench_sigkill_mid_retry_leaves_parseable_tail():
 
     repo, env = _cpu_bench_env()
     env["JAX_PLATFORMS"] = "nonexistent-backend"
+    banked_path = tmp_path / "error-latest.json"
+    env["SDA_BENCH_ERROR_FILE"] = str(banked_path)
     proc = subprocess.Popen(
         [
             sys.executable, "-S", str(repo / "bench.py"),
@@ -327,6 +331,13 @@ def test_bench_sigkill_mid_retry_leaves_parseable_tail():
     assert len(line["probe_attempts"]) >= 1
     # SIGKILL, not a clean exit: the give-up line never ran
     assert proc.returncode == -signal.SIGKILL
+    # the banked file survived the kill with a complete, parseable line
+    # (atomic replace: never torn), matching the stdout contract
+    banked = json.loads(banked_path.read_text())
+    assert banked["value"] == 0 and "probe" in banked["error"]
+    assert len(banked["probe_attempts"]) >= 1
+    # repo ships committed northstar artifacts, so provenance rides along
+    assert "last_witnessed" in banked
 
 
 def test_rest_ingest_script_sqlite():

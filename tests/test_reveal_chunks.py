@@ -1,0 +1,411 @@
+"""Paged snapshot-result delivery: the chunked, pipelined reveal must be
+byte-identical to the monolithic reveal.
+
+The tentpole contract mirrors the clerking plane's
+(tests/test_clerking_chunks.py): wire shape (legacy bulk SnapshotResult
+vs counts-only metadata + range GETs) is decided at REVEAL time from
+``SDA_RESULT_PAGE_THRESHOLD``, while the mask-column storage layout
+(inline vs externalized rows) is decided at SNAPSHOT time — so one
+stored snapshot is revealed BOTH ways. Each matrix config snapshots with
+threshold 0 (externalized layout where the backend has one), reveals the
+SAME snapshot once monolithically and once through the chunked prefetch
+pipeline, and asserts the two ``RecipientOutput``s are byte-identical —
+the streaming mask accumulator folds canonical residues in [0, m), so
+chunk boundaries cannot shift a single byte.
+
+Covers masking {None, Full, ChaCha} x sharing {additive, basic Shamir,
+packed Shamir} x chunk sizes {1, 4, 4096} spread across mem/file/sqlite
+and in-process/REST bindings, plus the empty-mask (NoMasking) metadata
+shape, the empty-snapshot cut, a mid-download server-restart retry, and
+a slow large-N RSS stress of the pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup, with_service
+from sda_tpu.client import SdaClient
+from sda_tpu.crypto import Keystore
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    BasicShamirSharing,
+    ChaChaMasking,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+
+DIM = 4
+MODULUS = 433
+
+MASKINGS = {
+    "none": lambda: NoMasking(),
+    "full": lambda: FullMasking(modulus=MODULUS),
+    "chacha": lambda: ChaChaMasking(modulus=MODULUS, dimension=DIM, seed_bitsize=128),
+}
+
+SHARINGS = {
+    "additive": lambda: AdditiveSharing(share_count=3, modulus=MODULUS),
+    "shamir": lambda: BasicShamirSharing(
+        share_count=5, privacy_threshold=2, prime_modulus=MODULUS
+    ),
+    "packed": lambda: PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=MODULUS,
+        omega_secrets=354,
+        omega_shares=150,
+    ),
+}
+
+# every masking meets every sharing; stores, bindings, and chunk sizes
+# are spread so each store sees ragged and aligned chunks and the REST
+# range routes are exercised against the sqlite ranged reads
+MATRIX = [
+    ("none", "additive", 1, "mem", False),
+    ("full", "shamir", 4, "sqlite", True),
+    ("chacha", "packed", 4096, "file", False),
+    ("full", "additive", 4, "file", False),
+    ("chacha", "shamir", 1, "mem", False),
+    ("none", "packed", 4, "sqlite", True),
+    ("chacha", "additive", 4096, "sqlite", True),
+    ("none", "shamir", 4096, "file", False),
+    ("full", "packed", 1, "mem", False),
+]
+
+N_PARTICIPANTS = 9  # 9 with chunk 4 -> two full + one ragged chunk
+
+
+def _configure(monkeypatch, store: str, http: bool) -> None:
+    if store == "mem":
+        monkeypatch.delenv("SDA_TEST_STORE", raising=False)
+    else:
+        monkeypatch.setenv("SDA_TEST_STORE", store)
+    monkeypatch.setenv("SDA_TEST_HTTP", "1" if http else "0")
+
+
+def _new_aggregation(recipient, rkey, masking, sharing, dim=DIM) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="reveal-chunks",
+        vector_dimension=dim,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=masking,
+        committee_sharing_scheme=sharing,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+@pytest.mark.parametrize("masking_name,sharing_name,chunk_size,store,http", MATRIX)
+def test_paged_equals_monolithic(
+    tmp_path, monkeypatch, masking_name, sharing_name, chunk_size, store, http
+):
+    _configure(monkeypatch, store, http)
+    sharing = SHARINGS[sharing_name]()
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=sharing.output_size
+        )
+        agg = _new_aggregation(recipient, rkey, MASKINGS[masking_name](), sharing)
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+
+        participant = new_client(tmp_path / "participant", ctx.service)
+        participant.upload_agent()
+        values = [[i % 5, (i + 2) % 5, 1, 0] for i in range(N_PARTICIPANTS)]
+        participant.upload_participations(
+            participant.new_participations(values, agg.id)
+        )
+
+        # externalize the stored mask column: threshold 0 at snapshot
+        # time forces the chunked layout on backends that have one
+        monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+        monkeypatch.setenv("SDA_RESULT_CHUNK_SIZE", str(chunk_size))
+        recipient.end_aggregation(agg.id)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+
+        # SAME stored snapshot, monolithic delivery: raising the
+        # threshold above the result size reassembles the bulk wire body
+        monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "1000000")
+        status = ctx.service.get_aggregation_status(recipient.agent, agg.id)
+        snap_id = status.snapshots[0].id
+        res_mono = ctx.service.get_snapshot_result(recipient.agent, agg.id, snap_id)
+        assert not res_mono.is_paged()
+        if masking_name == "none":
+            assert res_mono.recipient_encryptions is None
+        else:
+            assert len(res_mono.recipient_encryptions) == N_PARTICIPANTS
+        out_mono = recipient.reveal_aggregation(agg.id)
+
+        # ... and paged delivery through the prefetch pipeline
+        monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+        res_paged = ctx.service.get_snapshot_result(recipient.agent, agg.id, snap_id)
+        assert res_paged.is_paged()
+        assert res_paged.clerk_encryptions == []
+        assert res_paged.recipient_encryptions is None
+        assert res_paged.clerk_result_count == sharing.output_size
+        assert res_paged.chunk_size == chunk_size
+        if masking_name == "none":
+            # empty-mask snapshot: metadata says "no mask column at all"
+            assert res_paged.mask_encryption_count is None
+        else:
+            assert res_paged.mask_encryption_count == N_PARTICIPANTS
+        out_paged = recipient.reveal_aggregation(agg.id)
+
+        # byte-identical RecipientOutput regardless of delivery shape
+        assert out_mono.modulus == out_paged.modulus
+        assert out_mono.values.dtype == out_paged.values.dtype
+        np.testing.assert_array_equal(out_mono.values, out_paged.values)
+
+        expected = [
+            sum(v[d] for v in values) % agg.modulus for d in range(DIM)
+        ]
+        np.testing.assert_array_equal(out_paged.positive().values, expected)
+
+
+@pytest.mark.parametrize(
+    "store,http", [("mem", False), ("sqlite", True), ("file", False)]
+)
+def test_empty_snapshot_cut(tmp_path, monkeypatch, store, http):
+    """A snapshot with zero participations still pages (the clerk results
+    alone clear a zero threshold): the mask column is empty, every clerk
+    result decrypts to an empty share vector, and the reveal is the zero
+    vector — through the streaming machinery, not around it."""
+    _configure(monkeypatch, store, http)
+    monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_RESULT_CHUNK_SIZE", "4")
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=3
+        )
+        agg = _new_aggregation(
+            recipient,
+            rkey,
+            FullMasking(modulus=MODULUS),
+            AdditiveSharing(share_count=3, modulus=MODULUS),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        recipient.end_aggregation(agg.id)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+        status = ctx.service.get_aggregation_status(recipient.agent, agg.id)
+        res = ctx.service.get_snapshot_result(
+            recipient.agent, agg.id, status.snapshots[0].id
+        )
+        assert res.is_paged() and res.clerk_result_count == 3
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [0, 0, 0, 0])
+
+
+def test_mid_download_restart_retry(tmp_path, monkeypatch):
+    """A recipient interrupted mid-reveal retries against a restarted
+    server: the externalized mask column is durable in sqlite, the
+    re-fetched metadata matches, mask chunk 0 re-reads byte-identically,
+    and the completed reveal is the exact aggregate."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_sqlite_server
+
+    monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_RESULT_CHUNK_SIZE", "8")
+    db_path = str(tmp_path / "sda.db")
+    tokens = str(tmp_path / "tokens")
+    n = 40
+    values = [[i % 5, 1, 2, 3] for i in range(n)]
+
+    keystores = {}
+
+    def client_for(name, service):
+        if name not in keystores:
+            ks = Keystore(str(tmp_path / name))
+            keystores[name] = (ks, SdaClient.new_agent(ks))
+        ks, agent = keystores[name]
+        return SdaClient(agent, ks, service)
+
+    with serve_background(new_sqlite_server(db_path)) as url:
+        service = SdaHttpClient(url, TokenStore(tokens))
+        recipient = client_for("r", service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerk_clients = [client_for(f"c{i}", service) for i in range(2)]
+        for c in clerk_clients:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _new_aggregation(
+            recipient,
+            rkey,
+            FullMasking(modulus=MODULUS),
+            AdditiveSharing(share_count=2, modulus=MODULUS),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerk_clients]
+        )
+        participant = client_for("p", service)
+        participant.upload_agent()
+        participant.participate_many(values, agg.id, chunk_size=16)
+        recipient.end_aggregation(agg.id)
+        for c in clerk_clients:
+            c.run_chores(-1)
+
+        status = service.get_aggregation_status(recipient.agent, agg.id)
+        snap_id = status.snapshots[0].id
+        res_before = service.get_snapshot_result(recipient.agent, agg.id, snap_id)
+        assert res_before is not None and res_before.is_paged()
+        assert res_before.mask_encryption_count == n
+        assert res_before.clerk_result_count == 2
+        chunk0_before = service.get_snapshot_result_masks(
+            recipient.agent, agg.id, snap_id, 0
+        )
+        assert len(chunk0_before) == 8
+        # ... and the recipient "crashes" here, mid-download
+
+    with serve_background(new_sqlite_server(db_path)) as url:
+        service = SdaHttpClient(url, TokenStore(tokens))
+        recipient = client_for("r", service)
+
+        res_after = service.get_snapshot_result(recipient.agent, agg.id, snap_id)
+        assert res_after is not None and res_after.is_paged()
+        assert res_after.mask_encryption_count == n
+        assert res_after.clerk_result_count == 2
+        chunk0_after = service.get_snapshot_result_masks(
+            recipient.agent, agg.id, snap_id, 0
+        )
+        assert [e.to_json() for e in chunk0_after] == [
+            e.to_json() for e in chunk0_before
+        ]
+
+        expected = [
+            sum(v[d] for v in values) % agg.modulus for d in range(DIM)
+        ]
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, expected)
+
+
+def _rss_mib() -> float:
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+class _PeakRss:
+    """Background peak-RSS sampler (bench.py's _RssSampler, inlined)."""
+
+    def __init__(self):
+        import threading
+
+        self._stop = threading.Event()
+        self.peak = _rss_mib()
+
+        def run():
+            while not self._stop.is_set():
+                self.peak = max(self.peak, _rss_mib())
+                time.sleep(0.005)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join()
+        self.peak = max(self.peak, _rss_mib())
+
+
+@pytest.mark.slow
+def test_pipeline_stress_large_cohort_rss(tmp_path, monkeypatch):
+    """Large-N paged reveal over REST + sqlite: many mask chunks through
+    the prefetch thread, exact aggregate, reveal stage telemetry + the
+    overlap gauge populated, and the chunked reveal's peak RSS growth
+    well under the monolithic reveal's (flat-in-N memory)."""
+    from sda_tpu import telemetry
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_sqlite_server
+
+    monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_RESULT_CHUNK_SIZE", "256")
+    monkeypatch.setenv("SDA_TELEMETRY", "1")
+    n, dim = 4096, 512
+    with serve_background(new_sqlite_server(str(tmp_path / "sda.db"))) as url:
+        service = SdaHttpClient(url, TokenStore(str(tmp_path / "tokens")))
+        recipient, rkey, clerks = new_committee_setup(tmp_path, service, n_clerks=2)
+        agg = _new_aggregation(
+            recipient,
+            rkey,
+            FullMasking(modulus=MODULUS),
+            AdditiveSharing(share_count=2, modulus=MODULUS),
+            dim=dim,
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        participant = new_client(tmp_path / "participant", service)
+        participant.upload_agent()
+        participant.participate_many([[1] * dim] * n, agg.id, chunk_size=512)
+        recipient.end_aggregation(agg.id)
+        for clerk in clerks:
+            clerk.run_chores(-1)
+
+        # chunked FIRST (fresh baseline), monolithic second: the paged
+        # pipeline holds ~2 chunks + one partial, the bulk path the
+        # whole mask column + the full stacked combine
+        base = _rss_mib()
+        with _PeakRss() as chunked:
+            out_paged = recipient.reveal_aggregation(agg.id)
+        chunked_delta = chunked.peak - base
+
+        monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "1000000")
+        base = _rss_mib()
+        with _PeakRss() as mono:
+            out_mono = recipient.reveal_aggregation(agg.id)
+        mono_delta = mono.peak - base
+
+        np.testing.assert_array_equal(out_mono.values, out_paged.values)
+        expected = [n % agg.modulus] * dim
+        np.testing.assert_array_equal(out_paged.positive().values, expected)
+
+        # comparative, not absolute: allocator noise varies, but the
+        # monolithic path must pay for the whole column where the
+        # pipeline pays for a couple of chunks
+        assert chunked_delta < mono_delta * 0.75 + 16.0, (
+            f"chunked reveal RSS grew {chunked_delta:.1f} MiB vs "
+            f"monolithic {mono_delta:.1f} MiB"
+        )
+
+        snap = telemetry.snapshot(include_spans=0)
+        stages = {
+            h["labels"].get("stage")
+            for h in snap["histograms"]
+            if h["name"] == "sda_reveal_stage_seconds"
+        }
+        assert {"download", "decrypt", "fold", "reconstruct"} <= stages
+        assert any(
+            g["name"] == "sda_reveal_overlap_efficiency" for g in snap["gauges"]
+        )

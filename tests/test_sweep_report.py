@@ -118,6 +118,36 @@ def test_clerking_rider_section(tmp_path, capsys):
     assert "clerking-broken.json" not in out
 
 
+def test_reveal_rider_section(tmp_path, capsys):
+    _write(tmp_path, "reveal-20260805-030000.json",
+           {"metric": "reveal_pipeline",
+            "config": {"clerks": 2, "dim": 32},
+            "configs": {
+                "monolithic_4096": {"encryptions_per_s": 26000, "wall_s": 0.2,
+                                    "peak_rss_mib": 92.0, "chunk_size": None,
+                                    "n_participants": 4096,
+                                    "overlap_efficiency": None},
+                "chunked_4096": {"encryptions_per_s": 24000, "wall_s": 0.22,
+                                 "peak_rss_mib": 61.5, "chunk_size": 1024,
+                                 "n_participants": 4096,
+                                 "overlap_efficiency": 0.88,
+                                 "vs_monolithic": 0.92}}})
+    _write(tmp_path, "reveal-broken.json", {"note": "no configs"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # reveal rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "reveal-pipeline riders" in out
+    assert "reveal-20260805-030000.json" in out
+    assert "monolithic_4096" in out and "chunked_4096" in out
+    assert "0.88" in out  # overlap efficiency column
+    assert "reveal-broken.json" not in out
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]
