@@ -33,6 +33,14 @@ python setup.py build_ext --inplace || echo "ci: native build failed; Python fal
 echo "=== ci 1/4: test suite + backend/binding matrix + ladder quick ==="
 sh scripts/test-matrix.sh
 
+echo "=== ci 1b/4: serial-fallback smoke (SDA_WORKERS=1 exact path) ==="
+# the worker pool's serial short-circuit must stay the bit-for-bit
+# legacy path; pin it explicitly so a pool regression can't hide behind
+# the default (cpu_count) worker configuration the matrix runs under
+SDA_WORKERS=1 JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_workpool.py tests/test_clerking_chunks.py \
+    tests/test_reveal_chunks.py
+
 echo "=== ci 2/4: CLI acceptance walkthrough ==="
 sh scripts/simple-cli-example.sh
 

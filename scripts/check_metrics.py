@@ -57,6 +57,11 @@ REQUIRED_SERIES = [
     # pages every snapshot result)
     "sda_reveal_stage_seconds",
     "sda_reveal_overlap_efficiency",
+    # crypto worker pool: drive_workload runs its round at SDA_WORKERS=2,
+    # so the pooled dispatch path emits all three series
+    "sda_pool_workers",
+    "sda_pool_task_seconds",
+    "sda_pool_utilization",
 ]
 
 
@@ -117,6 +122,9 @@ def drive_workload(base_url: str, tmp: str) -> None:
     os.environ["SDA_JOB_CHUNK_SIZE"] = "2"
     os.environ["SDA_RESULT_PAGE_THRESHOLD"] = "0"
     os.environ["SDA_RESULT_CHUNK_SIZE"] = "2"
+    # a 2-worker round so the crypto pool's pooled dispatch path (and its
+    # sda_pool_* series) is exercised by the scrape
+    os.environ["SDA_WORKERS"] = "2"
     try:
         recipient.end_aggregation(agg.id)
         for clerk in clerks:
@@ -129,6 +137,7 @@ def drive_workload(base_url: str, tmp: str) -> None:
         os.environ.pop("SDA_JOB_CHUNK_SIZE", None)
         os.environ.pop("SDA_RESULT_PAGE_THRESHOLD", None)
         os.environ.pop("SDA_RESULT_CHUNK_SIZE", None)
+        os.environ.pop("SDA_WORKERS", None)
 
 
 def drive_engine() -> None:

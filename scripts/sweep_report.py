@@ -26,6 +26,14 @@ measure_reveal_pipeline) in the same shape: monolithic vs chunked reveal
 per cohort size, with peak recipient RSS and overlap efficiency — the
 evidence that reveal memory stays flat in N.
 
+Also tabulates the committee-scaling rider artifacts
+(``bench-artifacts/committee-<stamp>.json``, written by bench.py's
+measure_committee_scaling): one row per crypto plane (clerking / reveal /
+ingest) per SDA_WORKERS count, plus the sqlite read-pool thread probe,
+with a scaling-efficiency column (speedup over the serial run divided by
+the worker count; 1.0 = perfect scaling) and the host cpu_count the run
+measured on.
+
 Usage: python scripts/sweep_report.py [artifact_dir]
 """
 
@@ -204,6 +212,86 @@ def print_reveal(rows) -> None:
         )
 
 
+def load_committee(artdir: pathlib.Path):
+    """One row per plane x worker count (plus the read-pool thread probe)
+    per committee-*.json artifact, with scaling efficiency = speedup over
+    the serial run divided by the worker count (1.0 = perfect scaling)."""
+    rows = []
+    for f in sorted(artdir.glob("committee-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict):
+            continue
+        cpu = d.get("cpu_count")
+        planes = d.get("planes") if isinstance(d.get("planes"), dict) else {}
+        for plane, configs in sorted(planes.items()):
+            if not isinstance(configs, dict):
+                continue
+            for _, cfg in sorted(configs.items()):
+                if not isinstance(cfg, dict) or cfg.get("per_s") is None:
+                    continue
+                w, vs = cfg.get("workers"), cfg.get("vs_w1")
+                rows.append(
+                    {
+                        "artifact": f.name,
+                        "plane": plane,
+                        "workers": w,
+                        "per_s": cfg.get("per_s"),
+                        "vs_w1": vs,
+                        "efficiency": (
+                            round(vs / w, 2) if vs is not None and w else None
+                        ),
+                        "rss_mib": cfg.get("peak_rss_mib"),
+                        "identical": cfg.get("identical_to_serial"),
+                        "cpu": cpu,
+                    }
+                )
+        pool = d.get("read_pool") if isinstance(d.get("read_pool"), dict) else {}
+        for _, cfg in sorted(pool.items()):
+            if not isinstance(cfg, dict) or cfg.get("reads_per_s") is None:
+                continue
+            t, vs = cfg.get("threads"), cfg.get("vs_t1")
+            rows.append(
+                {
+                    "artifact": f.name,
+                    "plane": "read_pool",
+                    "workers": t,
+                    "per_s": cfg.get("reads_per_s"),
+                    "vs_w1": vs,
+                    "efficiency": (
+                        round(vs / t, 2) if vs is not None and t else None
+                    ),
+                    "rss_mib": None,
+                    # byte-identity is asserted on the crypto planes; the
+                    # read probe verifies row counts instead
+                    "identical": None,
+                    "cpu": cpu,
+                }
+            )
+    return rows
+
+
+def print_committee(rows) -> None:
+    print("\ncommittee-scaling riders (committee-*.json):")
+    print(
+        f"{'plane':>10} {'workers':>7} {'per_s':>9} {'vs_w1':>6} "
+        f"{'scal_eff':>8} {'rss_mib':>8} {'ident':>5} {'cpus':>4}  artifact"
+    )
+    for r in rows:
+        ident = "-" if r["identical"] is None else ("yes" if r["identical"] else "NO")
+        print(
+            f"{r['plane']:>10} {r['workers'] if r['workers'] is not None else '-':>7} "
+            f"{r['per_s']:>9} "
+            f"{r['vs_w1'] if r['vs_w1'] is not None else '-':>6} "
+            f"{r['efficiency'] if r['efficiency'] is not None else '-':>8} "
+            f"{r['rss_mib'] if r['rss_mib'] is not None else '-':>8} "
+            f"{ident:>5} {r['cpu'] if r['cpu'] is not None else '-':>4}  "
+            f"{r['artifact']}"
+        )
+
+
 def tag_of(row):
     # prefer the metric line (bench.py records rng/chunk/check since r5,
     # ADVICE r4 #2); filename tag as fallback for pre-r5 artifacts
@@ -234,10 +322,17 @@ def main() -> int:
     ingest_rows = load_ingest(artdir)
     clerking_rows = load_clerking(artdir)
     reveal_rows = load_reveal(artdir)
-    if not rows and not ingest_rows and not clerking_rows and not reveal_rows:
+    committee_rows = load_committee(artdir)
+    if (
+        not rows
+        and not ingest_rows
+        and not clerking_rows
+        and not reveal_rows
+        and not committee_rows
+    ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
-            f"or reveal-*.json artifacts under {artdir}/",
+            f"reveal-*.json, or committee-*.json artifacts under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -278,6 +373,8 @@ def main() -> int:
         print_clerking(clerking_rows)
     if reveal_rows:
         print_reveal(reveal_rows)
+    if committee_rows:
+        print_committee(committee_rows)
     return 0
 
 

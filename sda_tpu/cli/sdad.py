@@ -1,9 +1,15 @@
-"""``sdad`` — the server daemon.
+"""``sdad`` — the server daemon (and committee runner).
 
 Parity with /root/reference/server-cli/src/bin/sdad.rs: pick a storage
 backend (``--file root`` durable, ``--mem`` in-memory; the reference's
 equivalents are ``--jfs``/``--mongo``), then ``httpd -b ip:port`` (default
 127.0.0.1:8888).
+
+``committee`` runs several clerk identities concurrently against a
+remote server (``client.run_committee``): one worker thread per clerk,
+so committee wall time approaches the slowest member instead of the
+round-robin sum — the daemon shape for hosting a whole committee in one
+process.
 """
 
 from __future__ import annotations
@@ -11,6 +17,7 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
+import time
 
 from ..server import new_file_server, new_mem_server
 
@@ -27,13 +34,76 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd", help="run the REST server")
     httpd.add_argument("-b", "--bind", default="127.0.0.1:8888", metavar="IP:PORT")
+    committee = sub.add_parser(
+        "committee", help="run several clerk identities concurrently"
+    )
+    committee.add_argument(
+        "-s", "--server", default="http://127.0.0.1:8888", help="SDA service URL"
+    )
+    committee.add_argument(
+        "-i",
+        "--identity",
+        action="append",
+        required=True,
+        metavar="DIR",
+        help="clerk identity/keys directory (repeat once per clerk)",
+    )
+    committee.add_argument(
+        "-o", "--once", action="store_true", help="drain every queue once and exit"
+    )
+    committee.add_argument(
+        "-p", "--poll-seconds", type=float, default=5.0, metavar="SECONDS"
+    )
     return parser
+
+
+def run_committee_daemon(args) -> int:
+    from pathlib import Path
+
+    from ..client import SdaClient, run_committee
+    from ..crypto import Filebased, Keystore
+    from ..protocol import Agent, SdaError
+    from ..rest import SdaHttpClient, TokenStore
+
+    clerks = []
+    for d in args.identity:
+        identity = Path(d)
+        agent = Filebased(identity).get_aliased("agent", Agent.from_json)
+        if agent is None:
+            raise SystemExit(f"sdad: no agent identity under {identity}")
+        clerks.append(
+            SdaClient(
+                agent,
+                Keystore(identity / "keys"),
+                SdaHttpClient(args.server, TokenStore(identity)),
+            )
+        )
+    log.info("running a committee of %d clerks against %s", len(clerks), args.server)
+    while True:
+        try:
+            n = run_committee(clerks, -1)
+        except SdaError as e:
+            # a transient transport stall must not kill the daemon; the
+            # next poll retries. --once runs propagate: the caller asked
+            # for exactly one attempt and needs the failure.
+            if args.once:
+                raise
+            log.warning("committee pass failed (%s); retrying next poll", e)
+        else:
+            if n:
+                log.info("committee processed %d jobs", n)
+            if args.once:
+                return 0
+        time.sleep(args.poll_seconds)
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     level = [logging.INFO, logging.DEBUG][min(args.verbose, 1)]
     logging.basicConfig(level=level, stream=sys.stderr, format="%(asctime)s %(name)s %(message)s")
+
+    if args.command == "committee":
+        return run_committee_daemon(args)
 
     if args.file:
         service = new_file_server(args.file)

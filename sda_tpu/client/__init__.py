@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..crypto import CryptoModule, Keystore
 from ..protocol import Agent, AgentId, SdaService
 from .clerk import Clerking
+from .committee import run_committee
 from .participate import Participating
 from .profile import Maintenance
 from .receive import Receiving, RecipientOutput
@@ -38,4 +39,5 @@ __all__ = [
     "Receiving",
     "Maintenance",
     "RecipientOutput",
+    "run_committee",
 ]

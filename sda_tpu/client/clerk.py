@@ -11,18 +11,19 @@ clerk.rs:71-73.
 Large jobs arrive PAGED: the server returns metadata only
 (``total_encryptions`` + suggested ``chunk_size``) and the clerk pulls
 the ciphertext column range-by-range via ``get_clerking_job_chunk``.
-Download and compute overlap in a two-stage pipeline — a prefetch thread
-fetches chunk i+1 while the main thread decrypts + folds chunk i — so
-wall time approaches max(download, decrypt+combine) instead of their
-sum, with at most two chunks resident at once.
+Download and compute overlap in a bounded pipeline — up to
+``SDA_PREFETCH_DEPTH`` (default 3) range requests in flight while the
+main thread decrypts + folds the current chunk (client/prefetch.py) —
+so wall time approaches max(download, decrypt+combine) instead of their
+sum, with at most depth+1 chunks resident at once.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 
 from .. import telemetry
+from . import prefetch
 from ..ops.modular import positive
 from ..protocol import PackedPaillierEncryptionScheme, ClerkingResult, SdaError
 from .keys import VerifiedKeys
@@ -64,12 +65,11 @@ class Clerking(VerifiedKeys):
 
         Monolithic jobs slice the in-memory column by ``DECRYPT_CHUNK``.
         Paged jobs (``is_paged()`` — column left server-side) run the
-        download stage of the pipeline: chunk 0 is fetched synchronously,
-        then a prefetch thread downloads chunk i+1 while the consumer
-        decrypts chunk i. In-flight memory is bounded to two chunks: the
-        one being decrypted and the one being prefetched. The range
-        cursor advances by the length the server actually returned, so a
-        server configured with a different chunk size stays in lockstep.
+        download stage of the pipeline: up to ``SDA_PREFETCH_DEPTH``
+        range requests in flight while the consumer decrypts the current
+        chunk (client/prefetch.py ``iter_chunks``). The range cursor
+        advances by the length the server actually returned, so a server
+        configured with a different chunk size stays in lockstep.
         """
         if not job.is_paged():
             for start in range(0, len(job.encryptions), self.DECRYPT_CHUNK):
@@ -98,37 +98,7 @@ class Clerking(VerifiedKeys):
                 )
             return chunk
 
-        # the prefetch worker starts with a fresh contextvars context —
-        # rebind the caller's trace id so chunk GETs still carry
-        # X-SDA-Trace (same idiom as participate_many's upload thread)
-        trace_id = telemetry.current_trace_id()
-
-        def prefetch(start: int, box: list) -> None:
-            if trace_id:
-                telemetry.set_trace_id(trace_id)
-            try:
-                box.append(fetch(start))
-            except BaseException as exc:  # re-raised on the consumer side
-                box.append(exc)
-
-        chunk = fetch(0)
-        start = len(chunk)
-        while True:
-            worker = None
-            box: list = []
-            if start < total:
-                worker = threading.Thread(
-                    target=prefetch, args=(start, box), daemon=True
-                )
-                worker.start()
-            yield chunk
-            if worker is None:
-                return
-            worker.join()
-            if isinstance(box[0], BaseException):
-                raise box[0]
-            chunk = box[0]
-            start += len(chunk)
+        yield from prefetch.iter_chunks(fetch, total)
 
     def process_clerking_job(self, job) -> ClerkingResult:
         aggregation = self.service.get_aggregation(self.agent, job.aggregation)

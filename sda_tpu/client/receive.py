@@ -10,10 +10,11 @@ into [0, m) (receive.rs:8-21).
 Large snapshot results arrive PAGED: above ``SDA_RESULT_PAGE_THRESHOLD``
 the server answers ``get_snapshot_result`` with counts only and the
 recipient streams the mask-encryption column and the clerk-result list
-range-by-range. Download and compute overlap in a two-stage pipeline —
-a prefetch thread fetches chunk i+1 while the main thread runs the
-native batched sealed-box open on chunk i and folds the plaintext masks
-into a streaming modular accumulator (``MaskCombiner.accumulator``) —
+range-by-range. Download and compute overlap in a bounded pipeline —
+up to ``SDA_PREFETCH_DEPTH`` range requests in flight while the main
+thread runs the native batched sealed-box open on the current chunk and
+folds the plaintext masks into a streaming modular accumulator
+(``MaskCombiner.accumulator``) —
 so recipient memory stays flat in cohort size and wall time approaches
 max(download, decrypt+fold) instead of their sum. Small results keep the
 legacy bulk wire shape but route through the same accumulator as a
@@ -23,7 +24,6 @@ byte-identical — see tests/test_reveal_chunks.py).
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass
 
@@ -32,6 +32,7 @@ import numpy as np
 from .. import telemetry
 from ..ops.modular import positive
 from ..protocol import Committee, SdaError, Snapshot, SnapshotId
+from . import prefetch
 
 #: reveal pipeline stage latency — one histogram per stage; the bench
 #: rider and scripts/check_metrics.py key on this series name
@@ -43,11 +44,10 @@ def _iter_result_chunks(fetch, total: int, what: str, stage_times: dict):
     """Yield a paged snapshot-result column as decrypt-ready blocks.
 
     ``fetch(start)`` is the range read (``get_snapshot_result_masks`` or
-    ``get_snapshot_result_clerks``); chunk 0 is fetched synchronously,
-    then a prefetch thread downloads chunk i+1 while the consumer
-    decrypts chunk i — the clerk plane's pipeline (client/clerk.py
-    ``_iter_job_chunks``) applied to the reveal plane. In-flight memory
-    is bounded to two chunks. The range cursor advances by the length
+    ``get_snapshot_result_clerks``); chunks stream through the shared
+    bounded pipeline (client/prefetch.py ``iter_chunks``): up to
+    ``SDA_PREFETCH_DEPTH`` range requests in flight while the consumer
+    decrypts the current chunk. The range cursor advances by the length
     the server actually returned, so a server configured with a
     different chunk size stays in lockstep.
     """
@@ -68,34 +68,7 @@ def _iter_result_chunks(fetch, total: int, what: str, stage_times: dict):
             raise SdaError(f"snapshot result {what} truncated at {start}/{total}")
         return chunk
 
-    # the prefetch worker starts with a fresh contextvars context —
-    # rebind the caller's trace id so chunk GETs still carry X-SDA-Trace
-    trace_id = telemetry.current_trace_id()
-
-    def prefetch(start: int, box: list) -> None:
-        if trace_id:
-            telemetry.set_trace_id(trace_id)
-        try:
-            box.append(timed_fetch(start))
-        except BaseException as exc:  # re-raised on the consumer side
-            box.append(exc)
-
-    chunk = timed_fetch(0)
-    start = len(chunk)
-    while True:
-        worker = None
-        box: list = []
-        if start < total:
-            worker = threading.Thread(target=prefetch, args=(start, box), daemon=True)
-            worker.start()
-        yield chunk
-        if worker is None:
-            return
-        worker.join()
-        if isinstance(box[0], BaseException):
-            raise box[0]
-        chunk = box[0]
-        start += len(chunk)
+    yield from prefetch.iter_chunks(timed_fetch, total)
 
 
 @dataclass
@@ -114,14 +87,19 @@ class Receiving:
     def begin_aggregation(self, aggregation_id, *, chosen_clerks=None) -> None:
         """Elect the committee and open the aggregation for participation.
 
-        Default: the first ``output_size`` suggested candidates — the
-        reference's behavior (receive.rs:48-62). ``chosen_clerks`` (a
-        list of AgentIds) lets the recipient pick its own committee —
-        the reference's README "Doing more" roadmap item ("allow
-        recipient to actually chose the clerks"), delivered here. Order
-        defines committee position; every chosen clerk must be a
-        candidate (i.e. has uploaded a signed encryption key), and the
-        server still independently validates size and key signatures.
+        Default: the first ``output_size`` suggested candidates that are
+        not the recipient itself — the reference's behavior
+        (receive.rs:48-62) minus its footgun: a recipient with a signed
+        encryption key is a candidate too, and drafting it as a clerk
+        would let one party hold both a share column and the combined
+        result. ``chosen_clerks`` (a list of AgentIds) lets the
+        recipient pick its own committee — the reference's README
+        "Doing more" roadmap item ("allow recipient to actually chose
+        the clerks"), delivered here. Order defines committee position;
+        every chosen clerk must be a candidate (i.e. has uploaded a
+        signed encryption key), and the server still independently
+        validates size and key signatures. An explicit ``chosen_clerks``
+        containing the recipient is honored as chosen.
         """
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
@@ -129,7 +107,8 @@ class Receiving:
         candidates = self.service.suggest_committee(self.agent, aggregation_id)
         size = aggregation.committee_sharing_scheme.output_size
         if chosen_clerks is None:
-            selected = [(c.id, c.keys[0]) for c in candidates[:size]]
+            eligible = [c for c in candidates if c.id != aggregation.recipient]
+            selected = [(c.id, c.keys[0]) for c in eligible[:size]]
         else:
             if len(chosen_clerks) != size:
                 raise ValueError(

@@ -13,6 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import native
+from ..utils import workpool
 from ..protocol import (
     B32,
     Binary,
@@ -51,11 +52,15 @@ class SodiumEncryptor(ShareEncryptor):
         return Encryption(Binary(sodium.seal(encoded, self.pk)))
 
     def encrypt_batch(self, share_vectors) -> list:
-        """Seal many share vectors in one native batch call."""
+        """Seal many share vectors in one native batch call, split across
+        the shared worker pool when ``SDA_WORKERS`` > 1."""
         encoded = [native.varint_encode(np.asarray(v, dtype=np.int64)) for v in share_vectors]
-        return [
-            Encryption(Binary(ct)) for ct in native.seal_batch(encoded, self.pk)
-        ]
+        cts = workpool.map_items(
+            "seal",
+            encoded,
+            lambda sub, nt: native.seal_batch(sub, self.pk, n_threads=nt),
+        )
+        return [Encryption(Binary(ct)) for ct in cts]
 
 
 class SodiumDecryptor(ShareDecryptor):
@@ -75,8 +80,10 @@ class SodiumDecryptor(ShareDecryptor):
         for e in encryptions:
             if e.variant != "Sodium":
                 raise ValueError(f"sodium decryptor got a {e.variant} ciphertext")
-        raws = native.open_batch(
-            [bytes(e.inner) for e in encryptions], self.pk, self.sk
+        raws = workpool.map_items(
+            "open",
+            [bytes(e.inner) for e in encryptions],
+            lambda sub, nt: native.open_batch(sub, self.pk, self.sk, n_threads=nt),
         )
         return [native.varint_decode(r) for r in raws]
 
@@ -103,7 +110,12 @@ def encrypt_share_matrix(clerk_keys, scheme, share_rows) -> list:
             ]
             for row in share_rows
         ]
-        sealed = native.seal_participations(matrix, [ek.data for ek in clerk_keys])
+        pks = [ek.data for ek in clerk_keys]
+        sealed = workpool.map_items(
+            "share_matrix",
+            matrix,
+            lambda sub, nt: native.seal_participations(sub, pks, n_threads=nt),
+        )
         return [[Encryption(Binary(ct)) for ct in prow] for prow in sealed]
     encryptors = [new_share_encryptor(ek, scheme) for ek in clerk_keys]
     return [

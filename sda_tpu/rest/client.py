@@ -55,6 +55,14 @@ class SdaHttpClient(SdaService):
         self.token_store = token_store
         self.timeout = timeout
         self.session = requests.Session()
+        # urllib3's default pool keeps 10 connections per host; the
+        # concurrent committee runner plus K-deep chunk prefetch can
+        # exceed that against one server, and overflow connections are
+        # discarded after use (reconnect churn). Size the pool for the
+        # prefetch window times a committee's worth of clerks.
+        adapter = requests.adapters.HTTPAdapter(pool_connections=4, pool_maxsize=32)
+        self.session.mount("http://", adapter)
+        self.session.mount("https://", adapter)
         self.session.headers["User-Agent"] = "sda-tpu client"
 
     # -- plumbing -----------------------------------------------------------

@@ -368,6 +368,8 @@ class FileAggregationsStore(AggregationsStore):
         )
 
     def _read_mask_range(self, snapshot_id, start: int, end: int) -> list:
+        # lock-free like _read_column_range: idx + jsonl are immutable
+        # once the snapshot-mask metadata is visible
         if end <= start:
             return []
         data_path, idx_path = self._mask_paths(snapshot_id)
@@ -467,7 +469,11 @@ class FileClerkingJobsStore(ClerkingJobsStore):
 
     def _read_column_range(self, job_id, start: int, end: int) -> list:
         """Ciphertexts [start, end) via the offset sidecar: seek into the
-        idx for the bounding offsets, then one ranged read of the jsonl."""
+        idx for the bounding offsets, then one ranged read of the jsonl.
+
+        Deliberately lock-free: both files are written whole before the
+        job metadata lands (tmp + os.replace) and are immutable after,
+        so concurrent chunk readers never contend on a store lock."""
         if end <= start:
             return []
         data_path, idx_path = self._column_paths(job_id)

@@ -221,13 +221,16 @@ class MemAggregationsStore(AggregationsStore):
             return None if mask is None else len(mask)
 
     def get_snapshot_mask_range(self, snapshot_id, start, count):
+        # grab the reference under the lock, slice outside: the mask list
+        # is replaced whole by create_snapshot_mask, never mutated in
+        # place, so concurrent range readers don't convoy on the lock
         with self._lock:
             mask = self._snapshot_masks.get(snapshot_id)
-            if mask is None:
-                return None
-            if start < 0 or count < 0:
-                return []
-            return mask[start : start + count]
+        if mask is None:
+            return None
+        if start < 0 or count < 0:
+            return []
+        return mask[start : start + count]
 
 
 class MemClerkingJobsStore(ClerkingJobsStore):
@@ -268,13 +271,16 @@ class MemClerkingJobsStore(ClerkingJobsStore):
             return job
 
     def get_clerking_job_chunk(self, clerk_id, job_id, start, count):
+        # grab the job under the lock, slice outside: the encryption
+        # column is immutable after enqueue, so concurrent chunk readers
+        # (prefetch pipelines, many clerks) don't convoy on the lock
         with self._lock:
             job = self._jobs.get(job_id)
-            if job is None or job.clerk != clerk_id:
-                return None
-            if start < 0 or count < 0:
-                return []
-            return job.encryptions[start : start + count]
+        if job is None or job.clerk != clerk_id:
+            return None
+        if start < 0 or count < 0:
+            return []
+        return job.encryptions[start : start + count]
 
     def create_clerking_result(self, result) -> None:
         with self._lock:
@@ -289,16 +295,18 @@ class MemClerkingJobsStore(ClerkingJobsStore):
         # (sqlite's ORDER BY job), so snapshot-result bodies are
         # byte-stable across backends (asserted by test_replay_interop)
         with self._lock:
-            return sorted(self._results.get(snapshot_id, {}).keys(), key=str)
+            keys = list(self._results.get(snapshot_id, {}).keys())
+        return sorted(keys, key=str)  # O(n log n) outside the lock
 
     def get_result(self, snapshot_id, job_id):
         with self._lock:
             return self._results.get(snapshot_id, {}).get(job_id)
 
     def get_results(self, snapshot_id) -> list:
+        # copy the table under the lock, sort + build outside
         with self._lock:
-            table = self._results.get(snapshot_id, {})
-            return [table[job_id] for job_id in sorted(table.keys(), key=str)]
+            table = dict(self._results.get(snapshot_id, {}))
+        return [table[job_id] for job_id in sorted(table.keys(), key=str)]
 
     def count_results(self, snapshot_id) -> int:
         with self._lock:
@@ -308,6 +316,6 @@ class MemClerkingJobsStore(ClerkingJobsStore):
         if start < 0 or count < 0:
             return []
         with self._lock:
-            table = self._results.get(snapshot_id, {})
-            ordered = sorted(table.keys(), key=str)[start : start + count]
-            return [table[job_id] for job_id in ordered]
+            table = dict(self._results.get(snapshot_id, {}))
+        ordered = sorted(table.keys(), key=str)[start : start + count]
+        return [table[job_id] for job_id in ordered]

@@ -110,14 +110,22 @@ BUSY_TIMEOUT_S = 30.0
 
 
 class SqliteBackend:
-    """Shared connection + lock for all four stores over one database.
+    """Shared write connection + lock, per-thread read connections.
 
     ``self.lock`` serializes *threads* of one process on the shared
-    connection; ``transaction()`` (BEGIN IMMEDIATE) serializes
+    write connection; ``transaction()`` (BEGIN IMMEDIATE) serializes
     *processes* on the shared file — both are needed: the thread lock
     cannot see other processes, and sqlite's write lock cannot protect
     a Python check-then-act unless the check runs inside an immediate
     transaction.
+
+    Reads take neither lock: each reading thread gets its own
+    connection (``threading.local``), and WAL lets any number of
+    readers run concurrently with the single writer — so
+    ThreadingHTTPServer's per-request threads actually serve chunk
+    range-reads in parallel instead of convoying on one shared read
+    connection. Thread-local connections are reclaimed when their
+    thread dies (thread-per-request server) or at interpreter exit.
     """
 
     def __init__(self, path):
@@ -156,16 +164,23 @@ class SqliteBackend:
         self.lock = threading.RLock()
         with self.lock:
             self.conn.executescript(_SCHEMA)
-        # reads go through their own connection + lock: WAL lets readers
-        # run concurrently with the (single) writer, so a thread stuck in
-        # BEGIN IMMEDIATE's busy wait on another process must not stall
-        # this process's polls/status reads behind self.lock. ":memory:"
-        # has no shared file — a second connection would be a different
-        # database — so reads alias the write connection there.
-        if path == ":memory:":
-            self.read_conn, self.read_lock = self.conn, self.lock
-        else:
-            self.read_conn, self.read_lock = connect(), threading.RLock()
+        # reads go through per-thread connections: WAL lets readers run
+        # concurrently with the (single) writer, so neither a thread
+        # stuck in BEGIN IMMEDIATE's busy wait nor another reader's
+        # range scan can stall this thread's polls/status reads.
+        # ":memory:" has no shared file — a second connection would be a
+        # different database — so reads alias the write connection
+        # (under self.lock) there.
+        self._memory = path == ":memory:"
+        self._connect = connect
+        self._readers = threading.local()
+
+    def _read_conn(self):
+        """This thread's read connection, created on first use."""
+        conn = getattr(self._readers, "conn", None)
+        if conn is None:
+            conn = self._readers.conn = self._connect()
+        return conn
 
     @contextmanager
     def transaction(self):
@@ -195,13 +210,16 @@ class SqliteBackend:
             return self.conn.execute(sql, params)
 
     def query_one(self, sql, params=()):
-        with self.read_lock:
-            row = self.read_conn.execute(sql, params).fetchone()
-        return row
+        if self._memory:
+            with self.lock:
+                return self.conn.execute(sql, params).fetchone()
+        return self._read_conn().execute(sql, params).fetchone()
 
     def query_all(self, sql, params=()):
-        with self.read_lock:
-            return self.read_conn.execute(sql, params).fetchall()
+        if self._memory:
+            with self.lock:
+                return self.conn.execute(sql, params).fetchall()
+        return self._read_conn().execute(sql, params).fetchall()
 
     def create_row(self, table, id_col, id_val, cols: dict):
         """create-if-identical semantics via INSERT OR conflict check."""

@@ -64,12 +64,17 @@ class JsonDir:
         os.replace(tmp, target)
 
     def get(self, id):
-        with self._lock:
-            try:
-                with open(self._file(id)) as f:
-                    return json.load(f)
-            except FileNotFoundError:
-                return None
+        # lock-free read: writes land via tmp + os.replace, so a reader
+        # always opens either the complete old file or the complete new
+        # one — never a partial write. Only the get-then-put paths
+        # (create/create_once) need the directory lock; decoding JSON
+        # outside any lock keeps concurrent readers from convoying.
+        try:
+            with open(self._file(id)) as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        return json.loads(raw)
 
     def create(self, id, payload) -> None:
         """create-if-identical: reposting identical content is a no-op,

@@ -1,0 +1,133 @@
+"""Shared worker pool for bulk-crypto sub-range dispatch.
+
+The native seal/open kernels release the GIL (``Py_BEGIN_ALLOW_THREADS``
+around every libsodium hot loop), so a plain thread pool yields true
+multi-core crypto. This module owns the one process-wide pool: callers
+hand :func:`map_items` a list and a kernel that processes a contiguous
+sub-range, and get back the concatenated results in input order.
+
+Sizing: ``SDA_WORKERS`` in the environment, else ``os.cpu_count()``.
+``SDA_WORKERS=1`` (or a single-item batch) bypasses the pool entirely —
+the kernel is invoked once on the whole list with ``n_threads=None``,
+which is today's serial call, bit for bit.
+
+Determinism: sub-ranges are contiguous and results are gathered in
+submission order, so output item *i* always corresponds to input item
+*i* exactly as in the serial path. Deterministic kernels (``open``) are
+therefore byte-identical at any worker count; randomized kernels
+(``seal`` draws an ephemeral keypair per box) differ only by that
+randomness and open to identical plaintexts.
+
+Oversubscription: the native batch entry points spawn their own
+pthreads (``SDA_NATIVE_THREADS``, default cpu_count). When this pool is
+active each sub-range kernel receives ``n_threads=1`` so the total
+thread count stays at the pool size; the serial path passes ``None`` to
+keep the native default.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Sequence, TypeVar
+
+from .. import telemetry
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+_WORKERS_HELP = "configured crypto worker-pool size"
+_TASK_HELP = "per-sub-range pool task latency, by operation"
+_UTIL_HELP = "busy-time fraction of the last pooled dispatch (sum(task)/(wall*workers))"
+
+
+def workers() -> int:
+    """Configured pool size: ``SDA_WORKERS`` env, else ``os.cpu_count()``."""
+    raw = os.environ.get("SDA_WORKERS")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            raise ValueError(f"SDA_WORKERS must be an integer, got {raw!r}") from None
+    return os.cpu_count() or 1
+
+
+_pool: ThreadPoolExecutor | None = None
+_pool_size = 0
+_pool_lock = threading.Lock()
+
+
+def _executor(size: int) -> ThreadPoolExecutor:
+    """The shared executor, rebuilt if the configured size changed
+    (bench sweeps flip ``SDA_WORKERS`` between configs)."""
+    global _pool, _pool_size
+    with _pool_lock:
+        if _pool is None or _pool_size != size:
+            if _pool is not None:
+                _pool.shutdown(wait=False)
+            _pool = ThreadPoolExecutor(max_workers=size, thread_name_prefix="sda-pool")
+            _pool_size = size
+        return _pool
+
+
+def split_ranges(n: int, parts: int) -> List[tuple]:
+    """Balanced contiguous ``[start, end)`` bounds covering ``range(n)``."""
+    parts = max(1, min(parts, n))
+    base, extra = divmod(n, parts)
+    bounds, start = [], 0
+    for i in range(parts):
+        end = start + base + (1 if i < extra else 0)
+        bounds.append((start, end))
+        start = end
+    return bounds
+
+
+def map_items(
+    op: str,
+    items: Sequence[T],
+    kernel: Callable[[Sequence[T], "int | None"], List[R]],
+) -> List[R]:
+    """Run ``kernel(sub_range, n_threads)`` over ``items``, pooled.
+
+    ``kernel`` must map a contiguous sub-list to a result list of the
+    same length. With one worker (or one item) it is called exactly once
+    as ``kernel(items, None)`` — the unchanged serial path. Otherwise the
+    list is split into at most ``workers()`` contiguous sub-ranges, each
+    dispatched to the shared pool with ``n_threads=1``, and the result
+    lists are concatenated in input order. The first failing sub-range's
+    exception propagates.
+
+    ``op`` is a small fixed label ("seal"/"open"/"share_matrix") for the
+    ``sda_pool_task_seconds`` series — never unbounded values.
+    """
+    n = workers()
+    telemetry.gauge("sda_pool_workers", _WORKERS_HELP).set(n)
+    if n <= 1 or len(items) <= 1:
+        return kernel(items, None)
+
+    bounds = split_ranges(len(items), n)
+    task_hist = telemetry.histogram("sda_pool_task_seconds", _TASK_HELP, op=op)
+    busy = [0.0] * len(bounds)
+
+    def run(ix: int, lo: int, hi: int) -> List[R]:
+        t0 = time.perf_counter()
+        try:
+            return kernel(items[lo:hi], 1)
+        finally:
+            busy[ix] = time.perf_counter() - t0
+            task_hist.observe(busy[ix])
+
+    wall0 = time.perf_counter()
+    pool = _executor(n)
+    futures = [pool.submit(run, ix, lo, hi) for ix, (lo, hi) in enumerate(bounds)]
+    out: List[R] = []
+    for f in futures:  # submission order: deterministic in-order reassembly
+        out.extend(f.result())
+    wall = time.perf_counter() - wall0
+    if wall > 0:
+        telemetry.gauge("sda_pool_utilization", _UTIL_HELP).set(
+            min(1.0, sum(busy) / (wall * n))
+        )
+    return out

@@ -11,6 +11,7 @@ else in the suite.
 import threading
 
 import numpy as np
+import pytest
 
 from sda_tpu.protocol import (
     AdditiveSharing,
@@ -249,3 +250,98 @@ def test_chunked_clerk_combine_exact(tmp_path, monkeypatch):
             w.run_chores(-1)
         out = recipient.reveal_aggregation(agg.id).positive().values
         np.testing.assert_array_equal(out, [7, 14, 21, 28])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["mem", "file", "sqlite"])
+def test_thread_hammer_committee_round_through_rest(tmp_path, monkeypatch, backend):
+    """Thread-hammer: concurrent participants, then the whole committee
+    plus the status-polling recipient hammering one REST server at once,
+    with paging forced on (small chunks) so range reads hit the store from
+    many request threads simultaneously — per backend. Exercises the
+    sqlite per-thread read pool, the lock-trimmed mem/file read paths,
+    the pooled crypto plane, and the K-deep prefetch window together."""
+    import time
+
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.client import run_committee
+
+    monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", "4")
+    monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_WORKERS", "2")
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "3")
+
+    if backend == "file":
+        from sda_tpu.server import new_file_server
+
+        server = new_file_server(tmp_path / "store")
+    elif backend == "sqlite":
+        from sda_tpu.server import new_sqlite_server
+
+        server = new_sqlite_server(tmp_path / "store.db")
+    else:
+        from sda_tpu.server import new_mem_server
+
+        server = new_mem_server()
+
+    n_participants, n_clerks = 16, 4
+    with serve_background(server) as base_url:
+        def client(name):
+            d = tmp_path / "ids" / name
+            return new_client(d, SdaHttpClient(base_url, TokenStore(d)))
+
+        recipient = client("r")
+        recipient.upload_agent()
+        rkey = recipient.crypto.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [client(f"c{i}") for i in range(n_clerks)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = _additive_agg(recipient, rkey, share_count=n_clerks)
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+
+        participants = [client(f"p{i}") for i in range(n_participants)]
+        for p in participants:
+            p.upload_agent()
+        _run_threads(
+            [
+                (lambda p=p, i=i: p.participate([i + 1, 1, 2, 3], agg.id))
+                for i, p in enumerate(participants)
+            ]
+        )
+        recipient.end_aggregation(agg.id)
+
+        # committee drains concurrently while the recipient polls status
+        # through the same server — reads and writes interleave across
+        # every request thread
+        ready = []
+
+        def poll_until_ready():
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                status = recipient.service.get_aggregation_status(
+                    recipient.agent, agg.id
+                )
+                if status.snapshots and status.snapshots[0].result_ready:
+                    ready.append(True)
+                    return
+                time.sleep(0.01)
+
+        _run_threads([lambda: run_committee(clerks, -1), poll_until_ready])
+        assert ready, "clerking results never became ready under load"
+
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        want = np.array(
+            [
+                sum(range(1, n_participants + 1)) % 433,
+                n_participants % 433,
+                (2 * n_participants) % 433,
+                (3 * n_participants) % 433,
+            ]
+        )
+        np.testing.assert_array_equal(out, want)

@@ -228,6 +228,52 @@ def test_paillier_rejected_for_chacha_and_committee(tmp_path):
             recipient.upload_aggregation(agg2)
 
 
+def test_default_committee_skips_keyed_recipient(tmp_path):
+    """Default selection must never draft the recipient as a clerk: a
+    recipient with a signed encryption key is a committee *candidate*
+    (suggest_committee returns every keyed agent), and before the skip it
+    could land in the first output_size slots — leaving one real clerk
+    job-less and one party holding both a share column and the result.
+    With exactly output_size other candidates, the committee must be
+    exactly the clerks, and the clerks alone must complete the round."""
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)  # recipient is a candidate too
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+
+        agg = Aggregation(
+            id=AggregationId.random(), title="skip-recipient", vector_dimension=4,
+            modulus=433, recipient=recipient.agent.id, recipient_key=rkey,
+            masking_scheme=NoMasking(),
+            committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=433),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(agg.id)
+
+        committee = ctx.service.get_committee(recipient.agent, agg.id)
+        seated = [c for c, _ in committee.clerks_and_keys]
+        assert recipient.agent.id not in seated
+        assert sorted(seated, key=str) == sorted(
+            [c.agent.id for c in clerks], key=str
+        )
+
+        p = new_client(tmp_path / "p", ctx.service)
+        p.upload_agent()
+        p.participate([1, 2, 3, 4], agg.id)
+        recipient.end_aggregation(agg.id)
+        for c in clerks:  # the clerks alone must be able to finish
+            c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [1, 2, 3, 4])
+
+
 def test_recipient_chosen_committee(tmp_path):
     """The recipient picks its committee explicitly (the reference's
     'Doing more' roadmap item): chosen clerks in chosen order become the

@@ -148,6 +148,44 @@ def test_reveal_rider_section(tmp_path, capsys):
     assert "reveal-broken.json" not in out
 
 
+def test_committee_rider_section(tmp_path, capsys):
+    _write(tmp_path, "committee-20260805-040000.json",
+           {"metric": "committee_scaling",
+            "config": {"n_participants": 4000, "clerks": 2},
+            "cpu_count": 4,
+            "planes": {
+                "clerking": {
+                    "w1": {"workers": 1, "per_s": 9000, "wall_s": 0.44,
+                           "peak_rss_mib": 70.0, "vs_w1": 1.0,
+                           "identical_to_serial": True},
+                    "w4": {"workers": 4, "per_s": 27000, "wall_s": 0.15,
+                           "peak_rss_mib": 71.0, "vs_w1": 3.0,
+                           "identical_to_serial": True}},
+                "reveal": {
+                    "w1": {"workers": 1, "per_s": 8000, "wall_s": 0.5,
+                           "peak_rss_mib": 66.0, "vs_w1": 1.0,
+                           "identical_to_serial": True}}},
+            "read_pool": {
+                "t1": {"threads": 1, "reads_per_s": 20.0, "vs_t1": 1.0},
+                "t4": {"threads": 4, "reads_per_s": 76.0, "vs_t1": 3.8}}})
+    _write(tmp_path, "committee-broken.json", {"note": "no planes"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # committee rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "committee-scaling riders" in out
+    assert "committee-20260805-040000.json" in out
+    assert "clerking" in out and "read_pool" in out
+    # scaling efficiency = vs_w1 / workers: 3.0x on 4 workers -> 0.75, and
+    # the read-pool probe's 3.8x on 4 threads -> 0.95
+    assert "0.75" in out and "0.95" in out
+    assert "committee-broken.json" not in out
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]

@@ -1,0 +1,378 @@
+"""Concurrency-plane tests: worker pool, K-deep prefetch, committee runner.
+
+The load-bearing property is *worker-count invariance*: any value of
+``SDA_WORKERS`` must produce results identical to the serial path —
+byte-identical for deterministic kernels (sealed-box *opens*), and
+round-trip-identical for randomized kernels (*seals* draw an ephemeral
+keypair per box, so ciphertext bytes differ by that randomness but must
+open to the same plaintexts). ``utils/workpool.py`` guarantees this via
+contiguous sub-ranges reassembled in submission order.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sda_tpu.client import run_committee
+from sda_tpu.client import prefetch
+from sda_tpu.crypto.encryption import (
+    SodiumDecryptor,
+    SodiumEncryptor,
+    encrypt_share_matrix,
+    generate_encryption_keypair,
+)
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    BasicShamirSharing,
+    ChaChaMasking,
+    EncryptionKeyId,
+    FullMasking,
+    NoMasking,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.utils import workpool
+
+from sda_fixtures import new_client, with_service
+
+
+# -- workpool unit behavior ---------------------------------------------------
+
+
+def test_split_ranges_cover_contiguously():
+    for n in (1, 2, 5, 16, 17, 100):
+        for parts in (1, 2, 3, 8, n, n + 5):
+            bounds = workpool.split_ranges(n, parts)
+            assert bounds[0][0] == 0 and bounds[-1][1] == n
+            for (a, b), (c, d) in zip(bounds, bounds[1:]):
+                assert b == c and a < b and c < d
+            sizes = [b - a for a, b in bounds]
+            assert max(sizes) - min(sizes) <= 1  # balanced
+
+
+def test_workers_env_knob(monkeypatch):
+    monkeypatch.setenv("SDA_WORKERS", "5")
+    assert workpool.workers() == 5
+    monkeypatch.setenv("SDA_WORKERS", "0")
+    assert workpool.workers() == 1  # clamped
+    monkeypatch.setenv("SDA_WORKERS", "nope")
+    with pytest.raises(ValueError):
+        workpool.workers()
+    monkeypatch.delenv("SDA_WORKERS")
+    assert workpool.workers() >= 1
+
+
+def test_map_items_serial_path_is_one_call(monkeypatch):
+    monkeypatch.setenv("SDA_WORKERS", "1")
+    calls = []
+
+    def kernel(sub, n_threads):
+        calls.append((list(sub), n_threads))
+        return [x * 2 for x in sub]
+
+    items = list(range(10))
+    assert workpool.map_items("test", items, kernel) == [x * 2 for x in items]
+    # exactly today's call: the whole list, native thread default
+    assert calls == [(items, None)]
+
+
+def test_map_items_pooled_preserves_order(monkeypatch):
+    monkeypatch.setenv("SDA_WORKERS", "4")
+    seen = []
+    lock = threading.Lock()
+
+    def kernel(sub, n_threads):
+        assert n_threads == 1  # no native-thread oversubscription
+        with lock:
+            seen.append(list(sub))
+        return [x * 3 for x in sub]
+
+    items = list(range(23))
+    assert workpool.map_items("test", items, kernel) == [x * 3 for x in items]
+    assert 1 < len(seen) <= 4
+    assert sorted(x for sub in seen for x in sub) == items
+
+
+def test_map_items_single_item_stays_serial(monkeypatch):
+    monkeypatch.setenv("SDA_WORKERS", "8")
+    calls = []
+
+    def kernel(sub, n_threads):
+        calls.append(n_threads)
+        return list(sub)
+
+    assert workpool.map_items("test", ["only"], kernel) == ["only"]
+    assert calls == [None]
+
+
+def test_map_items_propagates_errors(monkeypatch):
+    monkeypatch.setenv("SDA_WORKERS", "3")
+
+    def kernel(sub, n_threads):
+        if 7 in sub:
+            raise RuntimeError("boom")
+        return list(sub)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        workpool.map_items("test", list(range(12)), kernel)
+
+
+# -- crypto invariance across worker counts -----------------------------------
+
+
+def test_open_batch_byte_identical_across_worker_counts(monkeypatch):
+    kp = generate_encryption_keypair()
+    vecs = [np.arange(i, i + 6, dtype=np.int64) - 3 for i in range(29)]
+    monkeypatch.setenv("SDA_WORKERS", "1")
+    cts = SodiumEncryptor(kp.ek).encrypt_batch(vecs)
+    dec = SodiumDecryptor(kp)
+    serial = dec.decrypt_batch(cts)
+    for w in ("2", "3", "8"):
+        monkeypatch.setenv("SDA_WORKERS", w)
+        pooled = dec.decrypt_batch(cts)
+        assert len(pooled) == len(serial)
+        for a, b in zip(serial, pooled):
+            np.testing.assert_array_equal(a, b)
+            assert a.dtype == b.dtype
+
+
+def test_seal_batch_pooled_roundtrips(monkeypatch):
+    kp = generate_encryption_keypair()
+    vecs = [np.arange(i, i + 5, dtype=np.int64) for i in range(17)]
+    monkeypatch.setenv("SDA_WORKERS", "3")
+    cts = SodiumEncryptor(kp.ek).encrypt_batch(vecs)
+    monkeypatch.setenv("SDA_WORKERS", "1")
+    out = SodiumDecryptor(kp).decrypt_batch(cts)
+    for v, o in zip(vecs, out):
+        np.testing.assert_array_equal(v, o)
+
+
+def test_share_matrix_pooled_roundtrips(monkeypatch):
+    keypairs = [generate_encryption_keypair() for _ in range(3)]
+    rows = [
+        np.arange(p * 12, p * 12 + 12, dtype=np.int64).reshape(3, 4)
+        for p in range(7)
+    ]
+    monkeypatch.setenv("SDA_WORKERS", "4")
+    sealed = encrypt_share_matrix(
+        [kp.ek for kp in keypairs], SodiumEncryptionScheme(), rows
+    )
+    monkeypatch.setenv("SDA_WORKERS", "1")
+    assert len(sealed) == len(rows)
+    for p, prow in enumerate(sealed):
+        for c, kp in enumerate(keypairs):
+            (opened,) = SodiumDecryptor(kp).decrypt_batch([prow[c]])
+            np.testing.assert_array_equal(opened, rows[p][c])
+
+
+# -- prefetch pipeline --------------------------------------------------------
+
+
+def _fetch_over(items, sizes):
+    """A fetch(start) over ``items`` whose chunk length is ``sizes[call#]``
+    (last size repeats); also records peak concurrent in-flight fetches."""
+    state = {"calls": 0, "inflight": 0, "peak": 0}
+    lock = threading.Lock()
+
+    def fetch(start):
+        with lock:
+            size = sizes[min(state["calls"], len(sizes) - 1)]
+            state["calls"] += 1
+            state["inflight"] += 1
+            state["peak"] = max(state["peak"], state["inflight"])
+        try:
+            return items[start : start + size]
+        finally:
+            with lock:
+                state["inflight"] -= 1
+
+    return fetch, state
+
+
+def test_iter_chunks_yields_everything_in_order(monkeypatch):
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "3")
+    items = list(range(50))
+    fetch, state = _fetch_over(items, [7])
+    out = [x for chunk in prefetch.iter_chunks(fetch, len(items)) for x in chunk]
+    assert out == items
+
+
+def test_iter_chunks_resyncs_on_stride_change(monkeypatch):
+    # server shrinks, then grows, its chunk size mid-column: the
+    # speculative window must resync without skipping or duplicating
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "4")
+    items = list(range(60))
+    for sizes in ([8, 3], [3, 9], [5, 2, 11, 1]):
+        fetch, _ = _fetch_over(items, sizes)
+        out = [x for chunk in prefetch.iter_chunks(fetch, len(items)) for x in chunk]
+        assert out == items, f"sizes={sizes}"
+
+
+def test_iter_chunks_depth_bounds_inflight(monkeypatch):
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "2")
+    items = list(range(40))
+    fetch, state = _fetch_over(items, [4])
+    out = [x for chunk in prefetch.iter_chunks(fetch, len(items)) for x in chunk]
+    assert out == items
+    # the consumer's own synchronous fetch can overlap the window
+    assert state["peak"] <= 3
+
+
+def test_iter_chunks_propagates_fetch_errors(monkeypatch):
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "3")
+
+    def fetch(start):
+        if start >= 8:
+            raise RuntimeError("range read failed")
+        return list(range(start, start + 4))
+
+    it = prefetch.iter_chunks(fetch, 16)
+    assert next(it) == [0, 1, 2, 3]
+    with pytest.raises(RuntimeError, match="range read failed"):
+        list(it)
+
+
+def test_prefetch_depth_env_knob(monkeypatch):
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "7")
+    assert prefetch.depth() == 7
+    monkeypatch.setenv("SDA_PREFETCH_DEPTH", "bad")
+    with pytest.raises(ValueError):
+        prefetch.depth()
+    monkeypatch.delenv("SDA_PREFETCH_DEPTH")
+    assert prefetch.depth() == 3
+
+
+# -- full-round invariance matrix --------------------------------------------
+
+
+def _round_agg(sharing, masking):
+    return Aggregation(
+        id=AggregationId.random(),
+        title="pool-matrix",
+        vector_dimension=4,
+        modulus=433,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=masking,
+        committee_sharing_scheme=sharing,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+_MATRIX = [
+    ("additive-nomask", AdditiveSharing(share_count=3, modulus=433), NoMasking()),
+    (
+        "additive-chacha",
+        AdditiveSharing(share_count=3, modulus=433),
+        ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
+    ),
+    ("additive-full", AdditiveSharing(share_count=3, modulus=433), FullMasking(modulus=433)),
+    (
+        "shamir-nomask",
+        BasicShamirSharing(share_count=3, privacy_threshold=1, prime_modulus=433),
+        NoMasking(),
+    ),
+]
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["monolithic", "paged"])
+@pytest.mark.parametrize("tag,sharing,masking", _MATRIX, ids=[m[0] for m in _MATRIX])
+def test_pooled_round_matches_serial_reveal(
+    tmp_path, monkeypatch, tag, sharing, masking, paged
+):
+    """Full round at SDA_WORKERS=3, then reveal twice — pooled and serial —
+    over the same server state: the outputs must be identical arrays (and
+    equal the expected aggregate). Covers sharing x masking x delivery."""
+    if paged:
+        monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+        monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", "3")
+        monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+    else:
+        monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "1000000")
+        monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "1000000")
+    monkeypatch.setenv("SDA_WORKERS", "3")
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        agg = _round_agg(sharing, masking)
+        agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+        recipient.upload_aggregation(agg)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        recipient.begin_aggregation(agg.id)
+        for i in range(5):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            p.participate([1, 2, 3, 4], agg.id)
+        recipient.end_aggregation(agg.id)
+        assert run_committee(clerks, -1) == 3
+        pooled = recipient.reveal_aggregation(agg.id).positive().values
+        monkeypatch.setenv("SDA_WORKERS", "1")
+        serial = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(pooled, serial)
+        np.testing.assert_array_equal(pooled, [5, 10, 15, 20])
+
+
+# -- committee runner ---------------------------------------------------------
+
+
+def test_run_committee_counts_and_drains(tmp_path, monkeypatch):
+    monkeypatch.setenv("SDA_WORKERS", "2")
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        agg = _round_agg(AdditiveSharing(share_count=3, modulus=433), NoMasking())
+        agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+        recipient.upload_aggregation(agg)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(3)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        recipient.begin_aggregation(agg.id)
+        p = new_client(tmp_path / "p", ctx.service)
+        p.upload_agent()
+        p.participate([4, 3, 2, 1], agg.id)
+        recipient.end_aggregation(agg.id)
+        assert run_committee(clerks, -1) == 3  # one job per committee seat
+        assert run_committee(clerks, -1) == 0  # queues drained
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        np.testing.assert_array_equal(out, [4, 3, 2, 1])
+
+
+def test_run_committee_empty_and_error_paths():
+    assert run_committee([]) == 0
+
+    class Broken:
+        def clerk_once(self):
+            raise RuntimeError("dead service")
+
+    class Quiet:
+        def clerk_once(self):
+            return False
+
+    with pytest.raises(RuntimeError, match="dead service"):
+        run_committee([Quiet(), Broken(), Quiet()], -1)
+
+
+def test_run_committee_bounded_iterations():
+    class Endless:
+        def __init__(self):
+            self.n = 0
+
+        def clerk_once(self):
+            self.n += 1
+            return True
+
+    clerks = [Endless(), Endless()]
+    assert run_committee(clerks, 4) == 8
+    assert [c.n for c in clerks] == [4, 4]
