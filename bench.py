@@ -222,6 +222,73 @@ def emit_error(msg: str, final: bool = True) -> None:
         print(json.dumps(line), flush=True)
 
 
+def _host_roofline_projection(args) -> dict:
+    """Device-free projection of the north-star rate for the partial
+    artifact a bounded probe gives up with: the v5e HBM roofline bound
+    for this run's scheme shape (same traffic model as the measured
+    roofline fields — every generated value element written once and
+    read once), anchored against the most recent *witnessed* device
+    number so the projection is calibrated, not just a datasheet bound.
+    """
+    k = max(1, args.secret_count)
+    over = 1.0 + args.privacy_threshold / k  # secrets + riding randomness
+    elem_bytes = 4.0  # int32 value elements, the engine's device dtype
+    hbm_bound = V5E_HBM_GBPS * 1e9 / (over * 2.0 * elem_bytes)
+    projection = {
+        "model": "v5e HBM peak / gen(write+read) bytes per shared element",
+        "overhead_factor": round(over, 3),
+        "elem_bytes": elem_bytes,
+        "hbm_bound_elems_per_s": round(hbm_bound, 1),
+        "note": "host-side upper-bound projection; device unmeasured this run",
+    }
+    witnessed = _last_witnessed()
+    if witnessed and witnessed.get("value"):
+        projection["witnessed_anchor"] = witnessed
+        projection["witnessed_frac_of_bound"] = round(
+            witnessed["value"] / hbm_bound, 4
+        )
+    return projection
+
+
+def emit_probe_fallback(msg: str, args, reason: str) -> None:
+    """The bounded probe's graceful-degradation path: instead of burning
+    the remaining deadline on more retries, emit a FINAL error-tagged
+    metric line that still carries everything the run did measure — the
+    host crypto-plane rates, the probe attempt schedule — plus the host
+    roofline projection, and bank it as a ``partial-<stamp>.json``
+    artifact (alongside the usual error bank) so a wedged chip leaves a
+    durable, non-zero-information artifact rather than five zeroed
+    rounds (BENCH_r01–r05)."""
+    line = {
+        "metric": METRIC_NAME,
+        "value": 0,
+        "unit": "shared_elements_per_second",
+        "vs_baseline": 0.0,
+        "error": msg,
+        "partial": True,
+        "probe_giveup": reason,
+        "host_projection": _host_roofline_projection(args),
+        "trace_id": RUN_TRACE_ID,
+    }
+    witnessed = _last_witnessed()
+    if witnessed:
+        line["last_witnessed"] = witnessed
+    if _CRYPTO_STATS:
+        line["crypto"] = _CRYPTO_STATS
+    if _PROBE_ATTEMPTS:
+        line["probe_attempts"] = _PROBE_ATTEMPTS
+    _bank_error_line(line)
+    if os.environ.get("SDA_BENCH_ARTIFACTS") != "0":
+        here = pathlib.Path(__file__).resolve().parent / "bench-artifacts"
+        try:
+            here.mkdir(exist_ok=True)
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            (here / f"partial-{stamp}.json").write_text(json.dumps(line, indent=2))
+        except OSError as exc:  # read-only checkout: keep the stdout evidence
+            print(f"[bench] partial artifact not written: {exc}", file=sys.stderr)
+    emit_final(line)
+
+
 def _env_float(name: str, default: float) -> float:
     raw = os.environ.get(name)
     if raw is None:
@@ -2583,6 +2650,15 @@ def main() -> int:
     # way attempts land every ~2.5-3 min until only `reserve` seconds of
     # deadline remain.
     reserve = 420.0  # device acquisition + parity + first compile room
+    # hard wall-clock bound on the whole probe phase (ROADMAP 3b): the
+    # retry loop may not consume more than SDA_BENCH_PROBE_BUDGET_S
+    # (default: a third of the deadline, capped at 600 s) before giving
+    # up with a partial artifact + host roofline projection — BENCH_r05
+    # burned its entire deadline retrying a wedged chip
+    probe_budget = _env_float(
+        "SDA_BENCH_PROBE_BUDGET_S",
+        min(600.0, args.deadline / 3.0) if args.deadline > 0 else 600.0,
+    )
     probe_t0 = time.perf_counter()
     while True:
         att_t0 = time.perf_counter()
@@ -2605,22 +2681,42 @@ def main() -> int:
         emit_error(err, final=False)
         elapsed = time.perf_counter() - probe_t0
         remaining = args.deadline - elapsed
-        if args.deadline <= 0 or remaining <= args.probe + reserve:
+        # out of budget when the phase has consumed it OR when another
+        # attempt could not even finish inside it — never start a probe
+        # that is guaranteed to overshoot the bound
+        out_of_probe_budget = elapsed + args.probe >= probe_budget
+        if (
+            args.deadline <= 0
+            or remaining <= args.probe + reserve
+            or out_of_probe_budget
+        ):
+            reason = (
+                f"probe budget ({probe_budget:.0f}s) exhausted"
+                if out_of_probe_budget
+                else "deadline budget exhausted"
+            )
             print(
                 f"[bench] {err} (gave up after {len(_PROBE_ATTEMPTS)} "
-                f"probe attempts over {elapsed:.0f}s)",
+                f"probe attempts over {elapsed:.0f}s: {reason}; emitting "
+                "partial artifact with host roofline projection)",
                 file=sys.stderr,
                 flush=True,
             )
-            emit_error(err)
+            emit_probe_fallback(err, args, reason)
             return 2
         print(
             f"[bench] {err}; retrying (attempt {len(_PROBE_ATTEMPTS) + 1} "
-            f"within deadline budget, {remaining:.0f}s left)",
+            f"within probe budget, {remaining:.0f}s of deadline left)",
             file=sys.stderr,
             flush=True,
         )
-        time.sleep(max(30.0, args.probe - (time.perf_counter() - att_t0)))
+        # never sleep past the probe budget: the next wake re-checks it
+        time.sleep(
+            min(
+                max(30.0, args.probe - (time.perf_counter() - att_t0)),
+                max(1.0, probe_budget - (time.perf_counter() - probe_t0)),
+            )
+        )
     # the watchdog gets what the retries left of the deadline, floored at
     # `reserve` (a probe that just succeeded deserves a real compile try)
     # — but the floor never exceeds the requested deadline itself, so an

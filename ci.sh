@@ -21,19 +21,23 @@
 #      visible in server-side spans
 #   4. examples/ — both runnable end-to-end demos (federated training,
 #      federated analytics) must keep running as documented
+#   5. scripts/scenarios.py — churn-scenario smoke over the real REST
+#      stack: vanish-after-sharing (threshold reveal from survivors) and
+#      clerk-kill-mid-chunk (sqlite persistence across process death);
+#      banked artifacts must record byte-exact reveals
 set -e
 cd "$(dirname "$0")"
 
-echo "=== ci 0/4: build native extension (Jenkinsfile 'build' stage) ==="
+echo "=== ci 0/5: build native extension (Jenkinsfile 'build' stage) ==="
 # in-place so the suite, bench.py, and the CLI all pick it up from the
 # checkout; the crypto plane falls back to Python if this fails, so a
 # missing toolchain degrades rates, not correctness
 python setup.py build_ext --inplace || echo "ci: native build failed; Python fallback paths will carry the crypto plane" >&2
 
-echo "=== ci 1/4: test suite + backend/binding matrix + ladder quick ==="
+echo "=== ci 1/5: test suite + backend/binding matrix + ladder quick ==="
 sh scripts/test-matrix.sh
 
-echo "=== ci 1b/4: serial-fallback smoke (SDA_WORKERS=1 exact path) ==="
+echo "=== ci 1b/5: serial-fallback smoke (SDA_WORKERS=1 exact path) ==="
 # the worker pool's serial short-circuit must stay the bit-for-bit
 # legacy path; pin it explicitly so a pool regression can't hide behind
 # the default (cpu_count) worker configuration the matrix runs under
@@ -41,13 +45,13 @@ SDA_WORKERS=1 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_workpool.py tests/test_clerking_chunks.py \
     tests/test_reveal_chunks.py
 
-echo "=== ci 2/4: CLI acceptance walkthrough ==="
+echo "=== ci 2/5: CLI acceptance walkthrough ==="
 sh scripts/simple-cli-example.sh
 
-echo "=== ci 3/4: telemetry exposition gate (live /v1/metrics scrape) ==="
+echo "=== ci 3/5: telemetry exposition gate (live /v1/metrics scrape) ==="
 JAX_PLATFORMS=cpu python scripts/check_metrics.py
 
-echo "=== ci 4/4: runnable examples (user-facing docs must not rot) ==="
+echo "=== ci 4/5: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null
 python examples/federated_analytics.py >/dev/null
 python examples/secure_sum_fabric.py >/dev/null
@@ -55,5 +59,28 @@ python examples/secure_sum_fabric.py >/dev/null
 # (~30 s) insurance that the deployment survives hard process death;
 # a failure here is a real resilience bug, not flake (seeds printed)
 python scripts/crash_soak.py 3
+
+echo "=== ci 5/5: churn-scenario smoke (named scenarios over real REST) ==="
+# two representative cells from the churn harness: clerks vanishing after
+# the sharing phase (threshold reveal from survivors) and a clerk killed
+# mid-chunk then resurrected (sqlite persistence across process death).
+# The banked artifacts must say the reveal was byte-exact, not merely ok.
+SCEN_ART="$(mktemp -d)"
+JAX_PLATFORMS=cpu python scripts/scenarios.py \
+    --scenarios vanish-after-sharing --stores mem --transports rest \
+    --artifacts "$SCEN_ART"
+JAX_PLATFORMS=cpu python scripts/scenarios.py \
+    --scenarios clerk-kill-mid-chunk --stores sqlite --transports rest \
+    --artifacts "$SCEN_ART"
+python - "$SCEN_ART" <<'EOF'
+import json, pathlib, sys
+arts = sorted(pathlib.Path(sys.argv[1]).glob("scenario-*.json"))
+assert len(arts) >= 2, f"expected two scenario artifacts, found {arts}"
+for f in arts:
+    d = json.loads(f.read_text())
+    assert d["ok"] and d["exact"] is True, f"{f.name}: {d}"
+print(f"ci: {len(arts)} scenario artifacts banked, all exact")
+EOF
+rm -rf "$SCEN_ART"
 
 echo "=== ci: all gates passed ==="

@@ -62,6 +62,10 @@ REQUIRED_SERIES = [
     "sda_pool_workers",
     "sda_pool_task_seconds",
     "sda_pool_utilization",
+    # churn plane: drive_faulted_leg reruns a round under SDA_FAULTS, so
+    # the injected failures and the client's recoveries must both show
+    "sda_fault_injections_total",
+    "sda_rest_retries_total",
 ]
 
 
@@ -108,7 +112,10 @@ def drive_workload(base_url: str, tmp: str) -> None:
     for clerk in clerks:
         clerk.upload_agent()
         clerk.upload_encryption_key(clerk.new_encryption_key())
-    recipient.begin_aggregation(agg.id)
+    # pin the committee to this round's clerks: the faulted leg reuses the
+    # server, so the candidate pool also holds earlier rounds' agents who
+    # would never run chores here and the snapshot would never turn ready
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
 
     participant = new_client("participant")
     participant.upload_agent()
@@ -138,6 +145,30 @@ def drive_workload(base_url: str, tmp: str) -> None:
         os.environ.pop("SDA_RESULT_PAGE_THRESHOLD", None)
         os.environ.pop("SDA_RESULT_CHUNK_SIZE", None)
         os.environ.pop("SDA_WORKERS", None)
+
+
+def drive_faulted_leg(base_url: str, tmp: str) -> None:
+    """Rerun the round workload under fault injection so the scrape must
+    contain the churn plane's series: sda_fault_injections_total (the
+    plane fired) and sda_rest_retries_total (the client recovered). A
+    ~15% transient-failure mix with an 8-retry budget makes an overall
+    failure astronomically unlikely (p ~ 0.15^9 per request) while
+    making at least one injection near-certain over a full round."""
+    saved = {
+        k: os.environ.get(k) for k in ("SDA_FAULTS", "SDA_REST_RETRIES",
+                                       "SDA_REST_BACKOFF_CAP_S")
+    }
+    os.environ["SDA_FAULTS"] = "drop=0.05,e503=0.05@0.01,truncate=0.05:17"
+    os.environ["SDA_REST_RETRIES"] = "8"
+    os.environ["SDA_REST_BACKOFF_CAP_S"] = "0.1"
+    try:
+        drive_workload(base_url, os.path.join(tmp, "faulted"))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def drive_engine() -> None:
@@ -187,6 +218,7 @@ def main() -> int:
     with serve_background(server) as base_url, tempfile.TemporaryDirectory() as tmp:
         with telemetry.trace("ci-check-metrics"):
             drive_workload(base_url, tmp)
+        drive_faulted_leg(base_url, tmp)
         drive_engine()
         with urllib.request.urlopen(f"{base_url}/v1/metrics", timeout=30) as resp:
             content_type = resp.headers.get("Content-Type", "")

@@ -34,6 +34,12 @@ with a scaling-efficiency column (speedup over the serial run divided by
 the worker count; 1.0 = perfect scaling) and the host cpu_count the run
 measured on.
 
+Also rolls the churn harness's banked cells (``scenario-<name>-*.json``,
+written by scripts/scenarios.py) into the survivability matrix: scenario
+rows x (store, transport) columns, latest artifact per cell, OK / FAIL /
+``--`` for never-run — plus any retry-layer overhead A/B records
+(``overhead-ab-*.json``).
+
 Usage: python scripts/sweep_report.py [artifact_dir]
 """
 
@@ -292,6 +298,67 @@ def print_committee(rows) -> None:
         )
 
 
+def load_scenarios(artdir: pathlib.Path):
+    """Latest record per (scenario, store, transport) cell from the churn
+    harness's scenario-*.json artifacts (scripts/scenarios.py), plus any
+    overhead-ab-*.json retry-layer A/B records."""
+    cells: dict = {}
+    for f in sorted(artdir.glob("scenario-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict) or not all(
+            k in d for k in ("scenario", "store", "transport", "ok")
+        ):
+            continue
+        # sorted() walks stamps ascending, so the last write wins = latest
+        cells[(d["scenario"], d["store"], d["transport"])] = {
+            "artifact": f.name,
+            "ok": bool(d["ok"]),
+            "exact": bool(d.get("exact")),
+            "error": d.get("error"),
+        }
+    overheads = []
+    for f in sorted(artdir.glob("overhead-ab-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(d, dict) and d.get("overhead_pct") is not None:
+            overheads.append({"artifact": f.name, **d})
+    return cells, overheads
+
+
+def print_scenarios(cells, overheads) -> None:
+    """The survivability matrix: scenario rows x (store, transport)
+    columns, latest artifact per cell; '--' = cell never run."""
+    print("\nchurn-scenario survivability (scenario-*.json, latest per cell):")
+    scenarios = sorted({k[0] for k in cells})
+    cols = sorted({(k[1], k[2]) for k in cells})
+    header = " ".join(f"{s[:4]}/{t[:4]:<4}" for s, t in cols)
+    print(f"{'scenario':<28} {header}")
+    for name in scenarios:
+        row = []
+        for s, t in cols:
+            cell = cells.get((name, s, t))
+            row.append("--" if cell is None else ("OK" if cell["ok"] else "FAIL"))
+        print(f"{name:<28} " + " ".join(f"{c:<9}" for c in row))
+    bad = [(k, c) for k, c in cells.items() if not c["ok"]]
+    if bad:
+        print("failing cells:")
+        for (name, s, t), c in bad:
+            print(f"  {name} [{s}/{t}]: {c['error']}  ({c['artifact']})")
+    else:
+        print(f"all {len(cells)} banked cells green")
+    for o in overheads:
+        print(
+            f"retry-layer overhead A/B: {o['overhead_pct']:+.2f}% over "
+            f"{o.get('requests_per_arm', '?')} requests/arm "
+            f"({'OK' if o.get('ok') else 'OVER BOUND'})  ({o['artifact']})"
+        )
+
+
 def tag_of(row):
     # prefer the metric line (bench.py records rng/chunk/check since r5,
     # ADVICE r4 #2); filename tag as fallback for pre-r5 artifacts
@@ -323,16 +390,19 @@ def main() -> int:
     clerking_rows = load_clerking(artdir)
     reveal_rows = load_reveal(artdir)
     committee_rows = load_committee(artdir)
+    scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
         not rows
         and not ingest_rows
         and not clerking_rows
         and not reveal_rows
         and not committee_rows
+        and not scenario_cells
     ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
-            f"reveal-*.json, or committee-*.json artifacts under {artdir}/",
+            f"reveal-*.json, committee-*.json, or scenario-*.json artifacts "
+            f"under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -375,6 +445,8 @@ def main() -> int:
         print_reveal(reveal_rows)
     if committee_rows:
         print_committee(committee_rows)
+    if scenario_cells:
+        print_scenarios(scenario_cells, overhead_rows)
     return 0
 
 

@@ -83,7 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     clerk = sub.add_parser("clerk", help="run a clerk in a loop")
     clerk.add_argument("-o", "--once", action="store_true", help="Run just once and leave")
     clerk.add_argument(
-        "--poll-seconds", type=float, default=300.0, help="Sleep between queue polls"
+        "--poll-seconds",
+        type=float,
+        default=2.0,
+        help="Max sleep between queue polls (jittered backoff ramps up "
+        "to this after an idle pass; the pre-backoff fixed sleep was 300)",
     )
 
     aggs = sub.add_parser(
@@ -276,12 +280,19 @@ def main(argv=None) -> int:
                 return 0
 
     if args.command == "clerk":
+        from ..utils.faults import Backoff
+
         client = SdaClient(require_agent(agent), keystore, service)
         service.ping()
+        # bounded jittered backoff between polls: a busy queue is
+        # re-polled almost immediately after draining, an idle or
+        # stalled server at most every poll_seconds — so neither a hot
+        # committee nor a wedged deployment makes the clerk spin
+        backoff = Backoff(cap=max(args.poll_seconds, 0.001))
         while True:
             log.debug("Polling for clerking job")
             try:
-                client.run_chores(-1)
+                n = client.run_chores(-1)
             except SdaError as e:
                 # a transient transport stall (REST timeout, connection
                 # reset) must not kill a long-running clerk daemon; the
@@ -290,9 +301,12 @@ def main(argv=None) -> int:
                 if args.once:
                     raise
                 log.warning("clerking pass failed (%s); retrying next poll", e)
+            else:
+                if n:
+                    backoff.reset()
             if args.once:
                 return 0
-            time.sleep(args.poll_seconds)
+            time.sleep(backoff.next_delay())
 
     if args.command in ("aggregations", "agg", "aggs", "aggregation"):
         client = SdaClient(require_agent(agent), keystore, service)

@@ -52,7 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
         "-o", "--once", action="store_true", help="drain every queue once and exit"
     )
     committee.add_argument(
-        "-p", "--poll-seconds", type=float, default=5.0, metavar="SECONDS"
+        "-p",
+        "--poll-seconds",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="max sleep between queue polls (jittered backoff ramps up "
+        "to this after an idle pass)",
     )
     return parser
 
@@ -79,6 +85,13 @@ def run_committee_daemon(args) -> int:
             )
         )
     log.info("running a committee of %d clerks against %s", len(clerks), args.server)
+    # bounded jittered backoff between polls: after a pass that found
+    # work the queues are re-polled almost immediately (stragglers from
+    # a snapshot land promptly); an idle or stalled server is probed at
+    # most every poll_seconds, so the daemon never spins
+    from ..utils.faults import Backoff
+
+    backoff = Backoff(cap=max(args.poll_seconds, 0.001))
     while True:
         try:
             n = run_committee(clerks, -1)
@@ -92,9 +105,10 @@ def run_committee_daemon(args) -> int:
         else:
             if n:
                 log.info("committee processed %d jobs", n)
+                backoff.reset()
             if args.once:
                 return 0
-        time.sleep(args.poll_seconds)
+        time.sleep(backoff.next_delay())
 
 
 def main(argv=None) -> int:

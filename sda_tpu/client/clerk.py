@@ -50,15 +50,20 @@ class Clerking(VerifiedKeys):
         self.service.create_clerking_result(self.agent, result)
         return True
 
-    def run_chores(self, max_iterations: int) -> None:
-        """Clerk repeatedly; negative means drain until no work is left."""
+    def run_chores(self, max_iterations: int) -> int:
+        """Clerk repeatedly; negative means drain until no work is left.
+        Returns the number of jobs processed, so daemon poll loops can
+        back off when a pass found the queue empty."""
+        done = 0
         if max_iterations < 0:
             while self.clerk_once():
-                pass
+                done += 1
         else:
             for _ in range(max_iterations):
                 if not self.clerk_once():
                     break
+                done += 1
+        return done
 
     def _iter_job_chunks(self, job, stage_times: dict):
         """Yield the job's ciphertext column as decrypt-ready blocks.

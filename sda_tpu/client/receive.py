@@ -31,8 +31,34 @@ import numpy as np
 
 from .. import telemetry
 from ..ops.modular import positive
-from ..protocol import Committee, SdaError, Snapshot, SnapshotId
+from ..protocol import AdditiveSharing, Committee, SdaError, Snapshot, SnapshotId
 from . import prefetch
+
+
+def require_reconstructible(scheme, present: int, committee_size: int) -> None:
+    """Gate the degraded reveal: Shamir-family schemes reconstruct from
+    any ``reconstruction_threshold``-sized subset of clerk results, so
+    missing clerks are tolerated down to the threshold; additive sharing
+    has no redundancy — summing a strict subset of shares silently
+    yields a wrong aggregate, so anything short of full attendance must
+    fail loudly here. The server's ``result_ready`` applies the same
+    threshold, but the client re-checks because it must never hand back
+    a wrong sum even against a miscounting (or malicious) server."""
+    threshold = scheme.reconstruction_threshold
+    if present >= threshold:
+        return
+    if isinstance(scheme, AdditiveSharing):
+        raise SdaError(
+            f"additive sharing cannot tolerate missing clerks: only "
+            f"{present} of {committee_size} clerk results present and "
+            "every share is required — a partial sum would be silently "
+            "wrong, not approximate"
+        )
+    raise SdaError(
+        f"not enough surviving clerk results to reconstruct: {present} of "
+        f"{committee_size} present, {type(scheme).__name__} needs at "
+        f"least {threshold}"
+    )
 
 #: reveal pipeline stage latency — one histogram per stage; the bench
 #: rider and scripts/check_metrics.py key on this series name
@@ -245,6 +271,18 @@ class Receiving:
                 (clerk_positions[cr.clerk], shares)
                 for cr, shares in zip(block, share_vectors)
             )
+
+        # degraded reveal: any >= reconstruction_threshold subset of the
+        # committee suffices for Shamir/packed (the vanished clerks'
+        # positions simply don't appear in indexed_shares and the
+        # Lagrange matrix is built from the survivors); additive requires
+        # all of them. Checked before the empty-cut shortcut so zero
+        # results can never masquerade as an empty aggregate.
+        require_reconstructible(
+            aggregation.committee_sharing_scheme,
+            len(indexed_shares),
+            len(committee.clerks_and_keys),
+        )
 
         if all(len(shares) == 0 for _, shares in indexed_shares):
             # an empty snapshot cut (every clerk combined zero

@@ -10,6 +10,7 @@ protocol error types.
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from typing import Optional
@@ -18,6 +19,7 @@ from urllib.parse import quote, urlencode
 import requests
 
 from .. import telemetry
+from ..utils import faults
 from ..protocol import (
     Agent,
     Aggregation,
@@ -48,6 +50,43 @@ from ..protocol import (
 DEFAULT_TIMEOUT_S = 300.0
 
 
+def _retry_budget() -> int:
+    """Extra attempts after the first, for retryable requests
+    (``SDA_REST_RETRIES``, default 4). 0 disables retrying."""
+    return max(0, int(os.environ.get("SDA_REST_RETRIES", "4")))
+
+
+def _backoff_base_s() -> float:
+    return float(os.environ.get("SDA_REST_BACKOFF_BASE_S", "0.05"))
+
+
+def _backoff_cap_s() -> float:
+    return float(os.environ.get("SDA_REST_BACKOFF_CAP_S", "2.0"))
+
+
+def _retry_after_cap_s() -> float:
+    """Upper bound honored for a server's Retry-After header — a sick or
+    hostile server must not be able to park the client for an hour."""
+    return float(os.environ.get("SDA_REST_RETRY_AFTER_CAP_S", "30.0"))
+
+
+#: transient server-side statuses worth retrying; 4xx are the caller's
+#: fault and never retried
+_RETRYABLE_STATUSES = (500, 502, 503, 504)
+
+
+def _retry_after_s(resp) -> float:
+    """Parse a delta-seconds Retry-After (the only form the SDA server
+    emits), clamped to the cap; HTTP-date forms fall back to 0."""
+    raw = resp.headers.get("Retry-After")
+    if not raw:
+        return 0.0
+    try:
+        return min(max(0.0, float(raw)), _retry_after_cap_s())
+    except ValueError:
+        return 0.0
+
+
 class SdaHttpClient(SdaService):
     def __init__(self, server_root: str, token_store,
                  timeout: float | None = DEFAULT_TIMEOUT_S):
@@ -67,7 +106,20 @@ class SdaHttpClient(SdaService):
 
     # -- plumbing -----------------------------------------------------------
 
-    def _request(self, method: str, path: str, caller=None, body=None, params=None):
+    def _request(self, method: str, path: str, caller=None, body=None, params=None,
+                 idempotent: bool | None = None):
+        """One protocol call, with transient-failure hardening.
+
+        ``idempotent=None`` (the default) retries GET/DELETE only. POST
+        call sites whose server handlers are idempotent by construction
+        (create-if-identical stores, upsert semantics, deterministic
+        snapshot no-op) pass ``idempotent=True`` to opt in — a replayed
+        create either matches byte-for-byte (absorbed) or conflicts
+        (fails like the first attempt would have). Retries cover
+        transport failures and transient 5xx only, with full-jitter
+        exponential backoff floored by the server's Retry-After; 4xx are
+        never retried.
+        """
         url = self.server_root + path
         if params:
             url += "?" + urlencode(params)
@@ -83,17 +135,48 @@ class SdaHttpClient(SdaService):
         if trace_id:
             # propagate the caller's trace id so server-side spans join it
             headers[telemetry.TRACE_HEADER] = trace_id
+        if idempotent is None:
+            idempotent = method in ("GET", "DELETE")
+        attempts = 1 + (_retry_budget() if idempotent else 0)
+        backoff = None  # built lazily: the happy path never touches it
+        floor = 0.0
         t0 = time.perf_counter()
-        try:
-            resp = self.session.request(
-                method, url, data=data, auth=auth, headers=headers,
-                timeout=self.timeout,
-            )
-        except requests.RequestException as exc:
-            # timeouts/connection failures join the documented error
-            # surface — daemon loops (e.g. `sda clerk`) catch SdaError
-            # and keep polling instead of dying on a transient stall
-            raise SdaError(f"HTTP/REST transport failure: {exc}") from exc
+        for attempt in range(attempts):
+            if attempt:
+                if backoff is None:
+                    backoff = faults.Backoff(
+                        base=_backoff_base_s(), cap=_backoff_cap_s()
+                    )
+                backoff.sleep(floor)
+                floor = 0.0
+            try:
+                fault = faults.client_draw()
+                if fault is not None:
+                    if fault.kind == "latency":
+                        time.sleep(fault.param)
+                    elif fault.kind == "drop":
+                        # synthetic connection death, routed through the
+                        # same except arm a real one would take
+                        raise requests.ConnectionError(
+                            "SDA_FAULTS: injected client-side connection drop"
+                        )
+                resp = self.session.request(
+                    method, url, data=data, auth=auth, headers=headers,
+                    timeout=self.timeout,
+                )
+            except requests.RequestException as exc:
+                if attempt + 1 < attempts:
+                    self._count_retry(method, path, "transport")
+                    continue
+                # timeouts/connection failures join the documented error
+                # surface — daemon loops (e.g. `sda clerk`) catch SdaError
+                # and keep polling instead of dying on a transient stall
+                raise SdaError(f"HTTP/REST transport failure: {exc}") from exc
+            if resp.status_code in _RETRYABLE_STATUSES and attempt + 1 < attempts:
+                floor = _retry_after_s(resp)
+                self._count_retry(method, path, f"status_{resp.status_code}")
+                continue
+            break
         if telemetry.enabled():
             telemetry.histogram(
                 "sda_http_client_request_seconds",
@@ -102,6 +185,17 @@ class SdaHttpClient(SdaService):
                 route=re.sub(r"[0-9a-fA-F-]{36}", "{id}", path),
             ).observe(time.perf_counter() - t0)
         return self._process(resp)
+
+    @staticmethod
+    def _count_retry(method: str, path: str, reason: str) -> None:
+        if telemetry.enabled():
+            telemetry.counter(
+                "sda_rest_retries_total",
+                "REST client retries by route template and reason",
+                method=method,
+                route=re.sub(r"[0-9a-fA-F-]{36}", "{id}", path),
+                reason=reason,
+            ).inc()
 
     @staticmethod
     def _process(resp) -> Optional[dict]:
@@ -126,15 +220,25 @@ class SdaHttpClient(SdaService):
 
     # -- agents -------------------------------------------------------------
 
+    # The POSTs below opt into retries (idempotent=True): every matching
+    # server handler is idempotent by construction — stores create via
+    # create-if-identical (byte-identical replays absorbed, conflicting
+    # ones rejected exactly like a first attempt), profiles are upserts,
+    # snapshot creation is a deterministic no-op on retry, and clerking
+    # results are job-keyed overwrites of identical bodies — so a replay
+    # after a lost response cannot double-apply.
+
     def create_agent(self, caller, agent) -> None:
-        self._request("POST", "/v1/agents/me", caller, agent)
+        # TOFU token registration accepts an identical re-registration
+        self._request("POST", "/v1/agents/me", caller, agent, idempotent=True)
 
     def get_agent(self, caller, agent_id):
         obj = self._request("GET", f"/v1/agents/{quote(str(agent_id))}", caller)
         return None if obj is None else Agent.from_json(obj)
 
     def upsert_profile(self, caller, profile) -> None:
-        self._request("POST", "/v1/agents/me/profile", caller, profile)
+        self._request("POST", "/v1/agents/me/profile", caller, profile,
+                      idempotent=True)
 
     def get_profile(self, caller, owner_id):
         from ..protocol import Profile
@@ -143,7 +247,8 @@ class SdaHttpClient(SdaService):
         return None if obj is None else Profile.from_json(obj)
 
     def create_encryption_key(self, caller, signed_key) -> None:
-        self._request("POST", "/v1/agents/me/keys", caller, signed_key)
+        self._request("POST", "/v1/agents/me/keys", caller, signed_key,
+                      idempotent=True)
 
     def get_encryption_key(self, caller, key_id):
         obj = self._request("GET", f"/v1/agents/any/keys/{quote(str(key_id))}", caller)
@@ -173,7 +278,8 @@ class SdaHttpClient(SdaService):
     # -- recipient ----------------------------------------------------------
 
     def create_aggregation(self, caller, aggregation) -> None:
-        self._request("POST", "/v1/aggregations", caller, aggregation)
+        self._request("POST", "/v1/aggregations", caller, aggregation,
+                      idempotent=True)
 
     def delete_aggregation(self, caller, aggregation_id) -> None:
         self._request("DELETE", f"/v1/aggregations/{quote(str(aggregation_id))}", caller)
@@ -187,7 +293,8 @@ class SdaHttpClient(SdaService):
         return [ClerkCandidate.from_json(c) for c in obj]
 
     def create_committee(self, caller, committee) -> None:
-        self._request("POST", "/v1/aggregations/implied/committee", caller, committee)
+        self._request("POST", "/v1/aggregations/implied/committee", caller,
+                      committee, idempotent=True)
 
     def get_aggregation_status(self, caller, aggregation_id):
         obj = self._request(
@@ -196,7 +303,8 @@ class SdaHttpClient(SdaService):
         return None if obj is None else AggregationStatus.from_json(obj)
 
     def create_snapshot(self, caller, snapshot) -> None:
-        self._request("POST", "/v1/aggregations/implied/snapshot", caller, snapshot)
+        self._request("POST", "/v1/aggregations/implied/snapshot", caller,
+                      snapshot, idempotent=True)
 
     def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
         obj = self._request(
@@ -231,7 +339,8 @@ class SdaHttpClient(SdaService):
     # -- participation ------------------------------------------------------
 
     def create_participation(self, caller, participation) -> None:
-        self._request("POST", "/v1/aggregations/participations", caller, participation)
+        self._request("POST", "/v1/aggregations/participations", caller,
+                      participation, idempotent=True)
 
     def create_participations(self, caller, participations) -> None:
         """Batched submit: the whole array in one request on the batch
@@ -243,6 +352,7 @@ class SdaHttpClient(SdaService):
             "/v1/aggregations/participations/batch",
             caller,
             [p.to_json() for p in participations],
+            idempotent=True,
         )
 
     # -- clerking -----------------------------------------------------------
@@ -267,4 +377,5 @@ class SdaHttpClient(SdaService):
             f"/v1/aggregations/implied/jobs/{quote(str(result.job))}/result",
             caller,
             result,
+            idempotent=True,
         )

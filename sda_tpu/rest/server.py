@@ -63,6 +63,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import telemetry
+from ..utils import faults
 from ..protocol import (
     Agent,
     AgentId,
@@ -96,6 +97,9 @@ class _Handler(BaseHTTPRequestHandler):
     _request_id = None
     _trace_id = None
     _status = None
+    # set by an SDA_FAULTS "truncate" draw: _send then declares the full
+    # Content-Length but delivers only half the body
+    _truncate_body = False
 
     # -- plumbing -----------------------------------------------------------
 
@@ -170,7 +174,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if body:
-            self.wfile.write(body)
+            if self._truncate_body and len(body) > 1:
+                # injected truncation: the declared length stands, only
+                # half the bytes arrive, and the connection dies — the
+                # client's content read sees a short body (urllib3
+                # enforces Content-Length) and surfaces a transport error
+                self.wfile.write(body[: len(body) // 2])
+                self.close_connection = True
+            else:
+                self.wfile.write(body)
 
     def _send_json_option(self, obj):
         if obj is None:
@@ -197,6 +209,30 @@ class _Handler(BaseHTTPRequestHandler):
         self._request_id = uuid.uuid4().hex[:16]
         self._status = None
         self._trace_id = None
+        self._truncate_body = False
+        fault = faults.server_draw()
+        if fault is not None:
+            if fault.kind == "latency":
+                time.sleep(fault.param)  # stall, then handle normally
+            elif fault.kind == "drop":
+                # connection death without an HTTP response; closing the
+                # keep-alive stream keeps the next request in sync
+                self.close_connection = True
+                return
+            elif fault.kind == "e503":
+                # answering without draining a POST body would desync
+                # the keep-alive stream (see _read_json) — drop the
+                # connection after the response instead
+                self.close_connection = True
+                self._send(
+                    503,
+                    b"SDA_FAULTS: injected transient failure",
+                    headers=[("Retry-After", f"{fault.param:g}"),
+                             ("Content-Type", "text/plain")],
+                )
+                return
+            elif fault.kind == "truncate":
+                self._truncate_body = True
         if telemetry.enabled():
             # adopt the client's trace id (or mint one) for this handler
             # thread; echoed back by _send alongside the request id

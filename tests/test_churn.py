@@ -1,0 +1,206 @@
+"""Churn plane: threshold reveal from surviving clerks.
+
+Vanish-after-sharing is the canonical churn shape: every participant
+sealed a share column to every committee member, then some clerks
+disappear before clerking. Shamir-family schemes reconstruct from any
+``reconstruction_threshold``-sized subset, so the reveal must succeed
+once that many clerk results exist — and, because Lagrange
+interpolation through any qualifying subset recovers the same
+polynomial, the degraded reveal must be byte-identical to the
+full-attendance reveal that becomes possible once the stragglers catch
+up. Additive sharing has no redundancy: a missing clerk means a
+silently wrong sum, so it must fail loudly instead.
+
+The matrix spreads {basic, packed Shamir} x {mem, file, sqlite} x
+{in-proc, REST} x {monolithic, paged result delivery} the same way
+tests/test_reveal_chunks.py does — each axis value appears against
+several of the others, including the paged REST routes where the
+partial clerk-result column is shorter than the committee.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup, with_service
+from sda_tpu.client.receive import require_reconstructible
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    BasicShamirSharing,
+    ChaChaMasking,
+    FullMasking,
+    NoMasking,
+    PackedShamirSharing,
+    SdaError,
+    SodiumEncryptionScheme,
+)
+
+DIM = 4
+MODULUS = 433
+N_PARTICIPANTS = 7
+
+SHARINGS = {
+    # 5 clerks, reconstruction threshold 3: tolerates 2 vanished
+    "shamir": lambda: BasicShamirSharing(
+        share_count=5, privacy_threshold=2, prime_modulus=MODULUS
+    ),
+    # 8 clerks, reconstruction threshold t+k = 7: tolerates 1 vanished
+    "packed": lambda: PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=MODULUS,
+        omega_secrets=354,
+        omega_shares=150,
+    ),
+}
+
+MASKINGS = {
+    "none": lambda: NoMasking(),
+    "full": lambda: FullMasking(modulus=MODULUS),
+    "chacha": lambda: ChaChaMasking(modulus=MODULUS, dimension=DIM, seed_bitsize=128),
+}
+
+# (sharing, vanished committee positions, masking, store, http, paged):
+# vanished positions are scattered (not a prefix) so the Lagrange matrix
+# is built from genuinely arbitrary evaluation points; every store and
+# both transports see both Shamir variants and both delivery shapes
+MATRIX = [
+    ("shamir", (0, 3), "chacha", "mem", False, False),
+    ("shamir", (1, 4), "full", "sqlite", True, True),
+    ("shamir", (2,), "none", "file", False, True),
+    ("shamir", (0, 2), "chacha", "mem", True, True),
+    ("packed", (5,), "chacha", "mem", True, False),
+    ("packed", (0,), "full", "sqlite", False, True),
+    ("packed", (7,), "none", "file", True, False),
+    ("packed", (3,), "chacha", "sqlite", True, True),
+]
+
+
+def _configure(monkeypatch, store: str, http: bool, paged: bool) -> None:
+    if store == "mem":
+        monkeypatch.delenv("SDA_TEST_STORE", raising=False)
+    else:
+        monkeypatch.setenv("SDA_TEST_STORE", store)
+    monkeypatch.setenv("SDA_TEST_HTTP", "1" if http else "0")
+    # paged: counts-only metadata + range reads with a ragged tail chunk;
+    # monolithic: the legacy bulk SnapshotResult wire shape
+    monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0" if paged else "1000000")
+    monkeypatch.setenv("SDA_RESULT_CHUNK_SIZE", "4")
+
+
+def _new_aggregation(recipient, rkey, masking, sharing) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="churn",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=masking,
+        committee_sharing_scheme=sharing,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+def _run_round(tmp_path, service, sharing, masking):
+    """Stand up a committee, submit N participations, cut the snapshot.
+
+    Returns (recipient, clerks, agg, expected positive aggregate)."""
+    recipient, rkey, clerks = new_committee_setup(
+        tmp_path, service, n_clerks=sharing.output_size
+    )
+    agg = _new_aggregation(recipient, rkey, masking, sharing)
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+    participant = new_client(tmp_path / "participant", service)
+    participant.upload_agent()
+    values = [[i % 5, (i + 2) % 5, 1, 0] for i in range(N_PARTICIPANTS)]
+    participant.upload_participations(participant.new_participations(values, agg.id))
+    recipient.end_aggregation(agg.id)
+    expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
+    return recipient, clerks, agg, expected
+
+
+@pytest.mark.parametrize(
+    "sharing_name,vanished,masking_name,store,http,paged", MATRIX
+)
+def test_reveal_from_surviving_clerks(
+    tmp_path, monkeypatch, sharing_name, vanished, masking_name, store, http, paged
+):
+    _configure(monkeypatch, store, http, paged)
+    sharing = SHARINGS[sharing_name]()
+    with with_service() as ctx:
+        recipient, clerks, agg, expected = _run_round(
+            tmp_path, ctx.service, sharing, MASKINGS[masking_name]()
+        )
+        survivors = [c for i, c in enumerate(clerks) if i not in vanished]
+        stragglers = [c for i, c in enumerate(clerks) if i in vanished]
+        assert len(survivors) >= sharing.reconstruction_threshold
+
+        for clerk in survivors:
+            clerk.run_chores(-1)
+
+        # degraded reveal: the vanished clerks never clerked, yet the
+        # surviving subset clears the threshold and yields the exact sum
+        out_partial = recipient.reveal_aggregation(agg.id)
+        np.testing.assert_array_equal(out_partial.positive().values, expected)
+
+        # stragglers catch up (the store re-serves their queued jobs);
+        # full attendance must reveal byte-identically to the degraded
+        # reveal — same polynomial, any qualifying subset
+        for clerk in stragglers:
+            clerk.run_chores(-1)
+        out_full = recipient.reveal_aggregation(agg.id)
+        assert out_full.modulus == out_partial.modulus
+        assert out_full.values.dtype == out_partial.values.dtype
+        np.testing.assert_array_equal(out_full.values, out_partial.values)
+
+
+@pytest.mark.parametrize(
+    "store,http", [("mem", False), ("sqlite", True), ("file", False)]
+)
+def test_additive_missing_clerk_is_not_ready(tmp_path, monkeypatch, store, http):
+    """Additive sharing needs every share: with one clerk vanished the
+    server never marks the snapshot ready (reconstruction_threshold ==
+    share_count), so the reveal fails loudly at the protocol level
+    instead of returning a silently wrong partial sum."""
+    _configure(monkeypatch, store, http, paged=False)
+    sharing = AdditiveSharing(share_count=3, modulus=MODULUS)
+    with with_service() as ctx:
+        recipient, clerks, agg, expected = _run_round(
+            tmp_path, ctx.service, sharing, FullMasking(modulus=MODULUS)
+        )
+        for clerk in clerks[:-1]:
+            clerk.run_chores(-1)
+        with pytest.raises(ValueError, match="not ready"):
+            recipient.reveal_aggregation(agg.id)
+        # the last clerk arrives: the round completes exactly
+        clerks[-1].run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id)
+        np.testing.assert_array_equal(out.positive().values, expected)
+
+
+def test_require_reconstructible_messages():
+    """The client-side guard (it re-checks even though the server gates
+    result_ready, so a miscounting server can never cause a wrong sum)."""
+    additive = AdditiveSharing(share_count=3, modulus=MODULUS)
+    shamir = SHARINGS["shamir"]()
+    packed = SHARINGS["packed"]()
+
+    # at or above threshold: no error
+    require_reconstructible(additive, 3, 3)
+    require_reconstructible(shamir, 3, 5)
+    require_reconstructible(shamir, 5, 5)
+    require_reconstructible(packed, 7, 8)
+
+    with pytest.raises(SdaError, match="cannot tolerate missing clerks"):
+        require_reconstructible(additive, 2, 3)
+    with pytest.raises(SdaError, match="needs at least 3"):
+        require_reconstructible(shamir, 2, 5)
+    with pytest.raises(SdaError, match="needs at least 7"):
+        require_reconstructible(packed, 6, 8)

@@ -42,6 +42,8 @@ def _cpu_bench_env():
     # must not change which code path each test exercises
     env.pop("SDA_BENCH_PROBE", None)
     env.pop("SDA_BENCH_DEADLINE", None)
+    env.pop("SDA_BENCH_PROBE_BUDGET_S", None)
+    env.pop("SDA_FAULTS", None)
     # test subprocesses must not litter bench-artifacts/ with ingest
     # rider artifacts (stdout metric lines still exercise the rider)
     env["SDA_BENCH_ARTIFACTS"] = "0"
@@ -276,6 +278,43 @@ def test_bench_probe_retries_within_deadline():
     # hung") or fail fast after it ("probe failed") — either is a failure
     assert all("probe" in a["result"] for a in attempts)
     assert "retrying" in out.stderr
+
+
+def test_bench_probe_budget_bounds_retries_and_projects():
+    """ROADMAP 3b (bounded-probe half): a hard wall-clock bound on the
+    probe phase. With SDA_BENCH_PROBE_BUDGET_S=1 and a deadline that
+    would otherwise fund many retries, the first failed attempt already
+    exhausts the budget — bench gives up immediately, and the final
+    metric line degrades gracefully: error-tagged but ``partial`` with
+    the give-up reason and a host roofline projection (HBM-bound rate
+    for this scheme shape) instead of five zeroed rounds of retrying."""
+    import json
+    import sys
+
+    repo, env = _cpu_bench_env()
+    env["JAX_PLATFORMS"] = "nonexistent-backend"
+    env["SDA_BENCH_PROBE_BUDGET_S"] = "1"
+    out = subprocess.run(
+        [
+            sys.executable, "-S", str(repo / "bench.py"),
+            "--participants", "2000", "--dim", "60", "--chunk", "1000",
+            # deadline high enough that the OLD give-up condition
+            # (remaining < probe+reserve) would keep retrying — only the
+            # probe budget can stop this run after one attempt
+            "--quick", "--probe", "2", "--deadline", "100000",
+        ],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=240,
+    )
+    assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["value"] == 0 and "probe" in line["error"]
+    assert line["partial"] is True
+    assert "probe budget" in line["probe_giveup"], line
+    assert len(line["probe_attempts"]) == 1, line["probe_attempts"]
+    proj = line["host_projection"]
+    # k=5, t=2 defaults: bound = 819e9 / (1.4 * 2 * 4) elements/s
+    assert proj["hbm_bound_elems_per_s"] > 1e9, proj
+    assert "upper-bound" in proj["note"]
 
 
 def test_bench_sigkill_mid_retry_leaves_parseable_tail(tmp_path):

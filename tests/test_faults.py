@@ -1,0 +1,354 @@
+"""Fault plane + REST retry hardening.
+
+The SDA_FAULTS plane must be deterministic (a spec + seed replays the
+exact failure sequence), the retry loop in the REST client must behave
+per contract (backoff floored by Retry-After, transient 5xx and
+transport failures retried on idempotent routes only, 4xx and
+non-idempotent POSTs never retried, every retry counted in
+``sda_rest_retries_total``), and — the acceptance bar — a full masked
+aggregation round over a REST deployment with double-digit injected
+failure rates must still complete EXACTLY, with the retries visible in
+telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from sda_tpu import telemetry
+from sda_tpu.protocol import SdaError
+from sda_tpu.utils import faults
+from sda_tpu.utils.faults import Backoff, FaultPlane, parse_spec
+
+
+# -- spec grammar -----------------------------------------------------------
+
+
+def test_parse_spec_grammar():
+    rules, seed = parse_spec("e503=0.1@0.2:42")
+    assert seed == 42
+    assert rules == [faults.Rule(side="server", kind="e503", rate=0.1, param=0.2)]
+
+    rules, seed = parse_spec("client.drop=0.05,latency=0.2@0.01,truncate=0.05:7")
+    assert seed == 7
+    assert [(r.side, r.kind, r.rate) for r in rules] == [
+        ("client", "drop", 0.05),
+        ("server", "latency", 0.2),
+        ("server", "truncate", 0.05),
+    ]
+    # per-kind parameter defaults apply when no @param is given
+    assert rules[2].param == 0.0
+
+    # no seed suffix: seed defaults to 0
+    rules, seed = parse_spec("drop=0.5")
+    assert seed == 0 and rules[0].rate == 0.5
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "",
+        "drop",  # no rate
+        "frobnicate=0.1",  # unknown kind
+        "proxy.drop=0.1",  # unknown side
+        "drop=1.5",  # rate out of range
+        "drop=-0.1",
+        "e503=0.1@-2",  # negative param
+        "drop=0.1:not-a-seed",
+        "drop=0.6,e503=0.6",  # server-side rates sum past 1
+    ],
+)
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def test_fault_plane_deterministic():
+    rules, seed = parse_spec("drop=0.2,e503=0.3@0.1,latency=0.2:99")
+    a = FaultPlane(rules, seed, "server")
+    b = FaultPlane(rules, seed, "server")
+    seq_a = [a.decide(i) for i in range(200)]
+    seq_b = [b.decide(i) for i in range(200)]
+    assert seq_a == seq_b
+    # stateful draw() walks the same pure sequence
+    assert [a.draw() for _ in range(200)] == seq_a
+    # a different seed yields a different sequence (astronomically sure)
+    c = FaultPlane(rules, 100, "server")
+    assert [c.decide(i) for i in range(200)] != seq_a
+    # rates are honored within tolerance over the long run
+    kinds = [f.kind for f in seq_a if f is not None]
+    assert 0.5 < len(kinds) / 200 < 0.9  # total rate 0.7
+
+
+def test_fault_plane_sides_partition():
+    rules, seed = parse_spec("client.drop=1.0,e503=1.0:5")
+    client = FaultPlane(rules, seed, "client")
+    server = FaultPlane(rules, seed, "server")
+    assert client.decide(0).kind == "drop"
+    assert server.decide(0).kind == "e503"
+    # each side only sees its own rules
+    assert len(client.rules) == 1 and len(server.rules) == 1
+
+
+# -- backoff ----------------------------------------------------------------
+
+
+def test_backoff_schedule():
+    import random
+
+    b = Backoff(base=0.05, factor=2.0, cap=2.0, rng=random.Random(7))
+    ceilings = []
+    for _ in range(8):
+        ceilings.append(b.ceiling())
+        delay = b.next_delay()
+        assert 0.0 <= delay <= ceilings[-1]
+    # exponential up to the cap, then flat
+    assert ceilings[:6] == [0.05, 0.1, 0.2, 0.4, 0.8, 1.6]
+    assert ceilings[6] == ceilings[7] == 2.0
+    # Retry-After floors the jittered delay
+    assert b.next_delay(floor=5.0) == 5.0
+    b.reset()
+    assert b.ceiling() == 0.05
+    # seeded rng makes the jittered schedule itself reproducible
+    b2 = Backoff(base=0.05, factor=2.0, cap=2.0, rng=random.Random(7))
+    b3 = Backoff(base=0.05, factor=2.0, cap=2.0, rng=random.Random(7))
+    assert [b2.next_delay() for _ in range(6)] == [
+        b3.next_delay() for _ in range(6)
+    ]
+
+
+# -- REST client retry behavior (scripted stub server) ----------------------
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    """Answers from a shared script of (status, headers) entries; once the
+    script drains, every request succeeds with a pong body."""
+
+    script: list = []
+    calls: list = []
+    lock = threading.Lock()
+
+    def _serve(self):
+        with self.lock:
+            type(self).calls.append((self.command, self.path, time.monotonic()))
+            step = self.script.pop(0) if self.script else None
+        status, headers = step if step else (200, {})
+        body = b'{"running": true}' if status == 200 else b"unwell"
+        self.send_response(status)
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        self._serve()
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        self._serve()
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture
+def stub_client(tmp_path):
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.tokenstore import TokenStore
+
+    _StubHandler.script = []
+    _StubHandler.calls = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    host, port = httpd.server_address[:2]
+    try:
+        yield SdaHttpClient(f"http://{host}:{port}", TokenStore(str(tmp_path)))
+    finally:
+        httpd.shutdown()
+        thread.join()
+
+
+def test_retry_on_503_honors_retry_after(stub_client, monkeypatch):
+    monkeypatch.setenv("SDA_REST_RETRIES", "4")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.01")
+    _StubHandler.script = [
+        (503, {"Retry-After": "0.3"}),
+        (503, {"Retry-After": "0.1"}),
+    ]
+    t0 = time.monotonic()
+    pong = stub_client.ping()
+    elapsed = time.monotonic() - t0
+    assert pong.running is True
+    assert len(_StubHandler.calls) == 3
+    # both Retry-After floors were honored (backoff alone caps at 10ms)
+    assert elapsed >= 0.4
+    # the gap after the FIRST 503 respected its 0.3s floor specifically
+    assert _StubHandler.calls[1][2] - _StubHandler.calls[0][2] >= 0.3
+
+
+def test_retry_counter_and_exhaustion(stub_client, monkeypatch):
+    monkeypatch.setenv("SDA_REST_RETRIES", "2")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.005")
+    monkeypatch.setenv("SDA_TELEMETRY", "1")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        _StubHandler.script = [(503, {})] * 10
+        with pytest.raises(SdaError, match="503"):
+            stub_client.ping()
+        # 1 first attempt + 2 retries, all burned
+        assert len(_StubHandler.calls) == 3
+        counters = {
+            (c["name"], c["labels"].get("reason")): c["value"]
+            for c in telemetry.snapshot(include_spans=0)["counters"]
+        }
+        assert counters[("sda_rest_retries_total", "status_503")] == 2
+    finally:
+        telemetry.reset()
+
+
+def test_non_idempotent_post_never_retried(stub_client, monkeypatch):
+    monkeypatch.setenv("SDA_REST_RETRIES", "4")
+    _StubHandler.script = [(503, {})] * 5
+    # default policy: POST without an explicit idempotent=True opt-in
+    # gets exactly one attempt — a replayed non-idempotent create could
+    # double-apply, so the client must surface the failure instead
+    with pytest.raises(SdaError, match="503"):
+        stub_client._request("POST", "/v1/unsafe", None, {"x": 1})
+    assert len(_StubHandler.calls) == 1
+
+
+def test_4xx_never_retried(stub_client, monkeypatch):
+    from sda_tpu.protocol import InvalidRequestError
+
+    monkeypatch.setenv("SDA_REST_RETRIES", "4")
+    _StubHandler.script = [(400, {})] * 5
+    with pytest.raises(InvalidRequestError):
+        stub_client.ping()
+    assert len(_StubHandler.calls) == 1
+
+
+def test_truncated_body_is_retried_transport_failure(tmp_path, monkeypatch):
+    """A server that declares the full Content-Length but sends half trips
+    urllib3's length check — the client sees a transport failure and
+    retries; with truncation at rate 1.0 every attempt fails and the
+    budget exhausts into SdaError."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_REST_RETRIES", "2")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.005")
+    with serve_background(new_mem_server()) as url:
+        client = SdaHttpClient(url, TokenStore(str(tmp_path)))
+        assert client.ping().running  # faults off: healthy
+        monkeypatch.setenv("SDA_FAULTS", "truncate=1.0:3")
+        with pytest.raises(SdaError, match="transport failure"):
+            client.ping()
+        monkeypatch.delenv("SDA_FAULTS")
+        assert client.ping().running  # plane off again: healthy
+
+
+# -- the acceptance bar: a faulted masked round completes exactly -----------
+
+
+def test_masked_round_survives_fault_storm(tmp_path, monkeypatch):
+    """Full ChaCha-masked additive round over REST+mem under ~20% injected
+    transient failure (server drop/503/latency/truncate + client-side
+    drops): every protocol call retries through, the revealed aggregate
+    is EXACT, and the retry + injection counters prove the storm was
+    real."""
+    from sda_fixtures import new_client, new_committee_setup
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    dim, modulus, n = 4, 433, 5
+    monkeypatch.setenv("SDA_REST_RETRIES", "8")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.005")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.2")
+    monkeypatch.setenv("SDA_TELEMETRY", "1")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with serve_background(new_mem_server()) as url:
+            service = SdaHttpClient(url, TokenStore(str(tmp_path / "tokens")))
+            # the storm starts AFTER the server is up; planes are cached
+            # per spec text so the sequence is reproducible per process
+            monkeypatch.setenv(
+                "SDA_FAULTS",
+                "drop=0.05,e503=0.1@0.02,latency=0.05@0.005,"
+                "truncate=0.05,client.drop=0.05:11",
+            )
+            recipient, rkey, clerks = new_committee_setup(
+                tmp_path, service, n_clerks=3
+            )
+            agg = Aggregation(
+                id=AggregationId.random(),
+                title="fault-storm",
+                vector_dimension=dim,
+                modulus=modulus,
+                recipient=recipient.agent.id,
+                recipient_key=rkey,
+                masking_scheme=ChaChaMasking(
+                    modulus=modulus, dimension=dim, seed_bitsize=128
+                ),
+                committee_sharing_scheme=AdditiveSharing(
+                    share_count=3, modulus=modulus
+                ),
+                recipient_encryption_scheme=SodiumEncryptionScheme(),
+                committee_encryption_scheme=SodiumEncryptionScheme(),
+            )
+            recipient.upload_aggregation(agg)
+            recipient.begin_aggregation(
+                agg.id, chosen_clerks=[c.agent.id for c in clerks]
+            )
+            participant = new_client(tmp_path / "participant", service)
+            participant.upload_agent()
+            values = [[i, i + 1, 2, 0] for i in range(n)]
+            participant.upload_participations(
+                participant.new_participations(values, agg.id)
+            )
+            recipient.end_aggregation(agg.id)
+            for clerk in clerks:
+                clerk.run_chores(-1)
+            out = recipient.reveal_aggregation(agg.id).positive().values
+            expected = [sum(v[d] for v in values) % modulus for d in range(dim)]
+            np.testing.assert_array_equal(out, expected)
+
+            counters = telemetry.snapshot(include_spans=0)["counters"]
+            retries = sum(
+                c["value"] for c in counters if c["name"] == "sda_rest_retries_total"
+            )
+            injections = sum(
+                c["value"]
+                for c in counters
+                if c["name"] == "sda_fault_injections_total"
+            )
+            assert retries > 0, counters
+            assert injections > 0, counters
+    finally:
+        telemetry.reset()

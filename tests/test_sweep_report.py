@@ -186,6 +186,44 @@ def test_committee_rider_section(tmp_path, capsys):
     assert "committee-broken.json" not in out
 
 
+def test_scenario_survivability_section(tmp_path, capsys):
+    _write(tmp_path, "scenario-vanish-after-sharing-20260805-050000-mem-rest.json",
+           {"scenario": "vanish-after-sharing", "store": "mem",
+            "transport": "rest", "ok": False, "exact": False,
+            "error": "boom (stale run)"})
+    # same cell, later stamp: latest record wins, so the cell turns green
+    _write(tmp_path, "scenario-vanish-after-sharing-20260805-060000-mem-rest.json",
+           {"scenario": "vanish-after-sharing", "store": "mem",
+            "transport": "rest", "ok": True, "exact": True, "error": None})
+    _write(tmp_path, "scenario-clerk-kill-mid-chunk-20260805-050000-sqlite-rest.json",
+           {"scenario": "clerk-kill-mid-chunk", "store": "sqlite",
+            "transport": "rest", "ok": False, "exact": False,
+            "error": "resurrected clerk found no job"})
+    _write(tmp_path, "scenario-broken-20260805.json", {"note": "no keys"})  # excluded
+    _write(tmp_path, "overhead-ab-20260805-050000.json",
+           {"overhead_pct": -0.10, "requests_per_arm": 1000, "ok": True})
+
+    cells, overheads = sweep_report.load_scenarios(tmp_path)
+    assert len(cells) == 2 and len(overheads) == 1
+    assert cells[("vanish-after-sharing", "mem", "rest")]["ok"] is True
+    assert cells[("clerk-kill-mid-chunk", "sqlite", "rest")]["ok"] is False
+
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # scenario rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "churn-scenario survivability" in out
+    assert "vanish-after-sharing" in out and "clerk-kill-mid-chunk" in out
+    # vanish row: mem/rest green, sqlite/rest never run -> dashed
+    assert "OK" in out and "--" in out
+    assert "resurrected clerk found no job" in out  # failing-cell detail
+    assert "retry-layer overhead A/B: -0.10%" in out and "1000 requests/arm" in out
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]
