@@ -837,6 +837,236 @@ class _RssSampler:
         return round(self.peak_kib / 1024.0, 1)
 
 
+def _emit_wire_line(tag: str, value, unit: str, vs_json, extra: dict) -> None:
+    """One roofline-tagged rider line per wire-transport leg (same
+    interim-line contract as _emit_ingest_line)."""
+    line = {
+        "metric": f"wire_transport_{tag}",
+        "value": value,
+        "unit": unit,
+        "vs_json": vs_json,
+        "trace_id": RUN_TRACE_ID,
+        **extra,
+    }
+    print(json.dumps(line), flush=True)
+
+
+def _wire_bytes_by_direction() -> dict:
+    """Sum sda_wire_bytes_total per (wire, direction) from the live
+    telemetry registry — the rider diffs two snapshots around a leg."""
+    totals: dict = {}
+    if not telemetry.enabled():
+        return totals
+    for c in telemetry.snapshot(include_spans=0)["counters"]:
+        if c["name"] != "sda_wire_bytes_total":
+            continue
+        key = f'{c["labels"].get("wire")}_{c["labels"].get("direction")}'
+        totals[key] = totals.get(key, 0) + c["value"]
+    return totals
+
+
+def measure_wire_transport(n_participants: int | None = None) -> dict:
+    """Binary-vs-JSON wire rider: the SAME round shape driven once per
+    wire format over a live loopback keep-alive server (mem store — the
+    store commit is the same on both legs, so the diff isolates
+    serialize + transport + parse), with the three hot routes measured
+    separately:
+
+    - ingest: one batch POST of the whole sealed cohort;
+    - clerking download: every chunk of one clerk's job column;
+    - reveal: the paged mask + clerk-result fetch and reconstruct.
+
+    Peak RSS is sampled per leg (the flat-memory claim), payload bytes
+    come from the sda_wire_bytes_total counters, and everything is
+    banked as bench-artifacts/wire-<stamp>.json."""
+    import tempfile
+
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        FullMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    n = n_participants or int(os.environ.get("SDA_BENCH_WIRE_N", "3000"))
+    chunk = 512
+    dim, modulus = 4, 433
+    out: dict = {"n_participants": n, "chunk_size": chunk, "store": "mem"}
+    env_keys = (
+        "SDA_WIRE",
+        "SDA_JOB_PAGE_THRESHOLD",
+        "SDA_JOB_CHUNK_SIZE",
+        "SDA_RESULT_PAGE_THRESHOLD",
+        "SDA_RESULT_CHUNK_SIZE",
+    )
+    saved_env = {k: os.environ.get(k) for k in env_keys}
+
+    def wire_leg(wire_env: str) -> dict:
+        os.environ["SDA_WIRE"] = wire_env
+        os.environ.pop("SDA_JOB_PAGE_THRESHOLD", None)
+        leg: dict = {}
+        with tempfile.TemporaryDirectory() as tmp, serve_background(
+            new_mem_server()
+        ) as url:
+            tmpp = pathlib.Path(tmp)
+            service = SdaHttpClient(url, TokenStore(str(tmpp / "tokens")))
+
+            def mk(name):
+                ks = Keystore(str(tmpp / name))
+                return SdaClient(SdaClient.new_agent(ks), ks, service)
+
+            recipient = mk("r")
+            recipient.upload_agent()
+            rkey = recipient.new_encryption_key()
+            recipient.upload_encryption_key(rkey)
+            clerks = [mk(f"c{i}") for i in range(3)]
+            for c in clerks:
+                c.upload_agent()
+                c.upload_encryption_key(c.new_encryption_key())
+            agg = Aggregation(
+                id=AggregationId.random(),
+                title="wire-bench",
+                vector_dimension=dim,
+                modulus=modulus,
+                recipient=recipient.agent.id,
+                recipient_key=rkey,
+                masking_scheme=FullMasking(modulus=modulus),
+                committee_sharing_scheme=AdditiveSharing(
+                    share_count=3, modulus=modulus
+                ),
+                recipient_encryption_scheme=SodiumEncryptionScheme(),
+                committee_encryption_scheme=SodiumEncryptionScheme(),
+            )
+            recipient.upload_aggregation(agg)
+            recipient.begin_aggregation(
+                agg.id, chosen_clerks=[c.agent.id for c in clerks]
+            )
+            participant = mk("p")
+            participant.upload_agent()
+            # the sealed batch is built OUTSIDE the timed window: this
+            # rider measures the wire, not the sealer
+            batch = participant.new_participations([[1, 2, 3, 4]] * n, agg.id)
+
+            bytes_before = _wire_bytes_by_direction()
+            with _RssSampler() as rss:
+                t0 = time.perf_counter()
+                participant.upload_participations(batch)
+                leg["ingest_s"] = round(time.perf_counter() - t0, 4)
+
+                os.environ["SDA_JOB_PAGE_THRESHOLD"] = "0"
+                os.environ["SDA_JOB_CHUNK_SIZE"] = str(chunk)
+                os.environ["SDA_RESULT_PAGE_THRESHOLD"] = "0"
+                os.environ["SDA_RESULT_CHUNK_SIZE"] = str(chunk)
+                recipient.end_aggregation(agg.id)
+
+                # clerking download: one clerk's whole column, chunk by
+                # chunk through the negotiated route
+                clerk0 = clerks[0]
+                job = service.get_clerking_job(clerk0.agent, clerk0.agent.id)
+                t0 = time.perf_counter()
+                got = 0
+                while got < job.total_encryptions:
+                    items = service.get_clerking_job_chunk(
+                        clerk0.agent, job.id, got
+                    )
+                    got += len(items)
+                leg["clerking_fetch_s"] = round(time.perf_counter() - t0, 4)
+
+                for c in clerks:
+                    c.run_chores(-1)
+
+                t0 = time.perf_counter()
+                revealed = recipient.reveal_aggregation(agg.id)
+                leg["reveal_s"] = round(time.perf_counter() - t0, 4)
+            leg["peak_rss_mib"] = rss.peak_mib
+            expected = [(n * v) % modulus for v in (1, 2, 3, 4)]
+            if list(revealed.positive().values) != expected:
+                raise RuntimeError(f"wire rider reveal mismatch on {wire_env}")
+
+            after = _wire_bytes_by_direction()
+            for key, val in after.items():
+                delta = val - bytes_before.get(key, 0)
+                if delta:
+                    leg[f"bytes_{key}"] = int(delta)
+        leg["ingest_per_s"] = round(n / leg["ingest_s"])
+        leg["clerking_fetch_per_s"] = round(n / leg["clerking_fetch_s"])
+        leg["reveal_per_s"] = round(n / leg["reveal_s"])
+        return leg
+
+    try:
+        out["json"] = wire_leg("json")
+        out["binary"] = wire_leg("binary")
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # the acceptance bar: binary + keep-alive vs the pre-binary JSON ingest
+    # plane (thread-per-connection server, JSON bodies), which topped out at
+    # ~11K participations/s on this host — the figure the wire work targets
+    json_baseline_per_s = 11_000
+    out["json_baseline_per_s"] = json_baseline_per_s
+    out["ingest_binary_vs_baseline"] = round(
+        out["binary"]["ingest_per_s"] / json_baseline_per_s, 2
+    )
+    for tag, per_s in (
+        ("ingest", "ingest_per_s"),
+        ("clerking_fetch", "clerking_fetch_per_s"),
+        ("reveal", "reveal_per_s"),
+    ):
+        ratio = round(out["binary"][per_s] / max(1, out["json"][per_s]), 2)
+        out[f"{tag}_binary_vs_json"] = ratio
+        extra_baseline = (
+            {"binary_vs_baseline": out["ingest_binary_vs_baseline"],
+             "json_baseline_per_s": json_baseline_per_s}
+            if tag == "ingest"
+            else {}
+        )
+        _emit_wire_line(
+            tag,
+            out["binary"][per_s],
+            "participations_per_second",
+            ratio,
+            {
+                **extra_baseline,
+                "json_per_s": out["json"][per_s],
+                "binary_per_s": out["binary"][per_s],
+                "peak_rss_json_mib": out["json"]["peak_rss_mib"],
+                "peak_rss_binary_mib": out["binary"]["peak_rss_mib"],
+                "roofline": {
+                    "plane": "loopback_rest",
+                    "bound": "serialize_parse_then_store_commit",
+                    "wire": "binary",
+                    "n": n,
+                },
+            },
+        )
+    out["rss_flat"] = (
+        out["binary"]["peak_rss_mib"] <= out["json"]["peak_rss_mib"] * 1.1 + 32
+    )
+
+    payload = {"metric": "wire_transport", **out}
+    if os.environ.get("SDA_BENCH_ARTIFACTS") == "0":
+        return out
+    here = pathlib.Path(__file__).resolve().parent / "bench-artifacts"
+    try:
+        here.mkdir(exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        (here / f"wire-{stamp}.json").write_text(json.dumps(payload, indent=2))
+    except OSError as exc:
+        print(f"[bench] wire artifact not written: {exc}", file=sys.stderr)
+    return out
+
+
 def _emit_clerking_line(tag: str, value, unit: str, vs_monolithic, extra: dict) -> None:
     """One roofline-tagged rider line per clerking delivery config (same
     interim-line contract as _emit_ingest_line: the driver reads only the
@@ -2620,6 +2850,11 @@ def main() -> int:
             _CRYPTO_STATS["ingest"] = measure_batched_ingest()
     except Exception as exc:
         print(f"[bench] batched-ingest rider failed: {exc}", file=sys.stderr)
+    try:
+        with stage("wire-transport rider"):
+            _CRYPTO_STATS["wire"] = measure_wire_transport()
+    except Exception as exc:
+        print(f"[bench] wire-transport rider failed: {exc}", file=sys.stderr)
     try:
         with stage("clerking-pipeline rider"):
             _CRYPTO_STATS["clerking"] = measure_clerking_pipeline()

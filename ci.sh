@@ -45,6 +45,16 @@ SDA_WORKERS=1 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_workpool.py tests/test_clerking_chunks.py \
     tests/test_reveal_chunks.py
 
+echo "=== ci 1c/5: wire-format matrix (binary default + JSON legacy leg) ==="
+# the negotiated binary wire is the default transport on the hot routes;
+# the same suite must also hold with SDA_WIRE=json, which pins the legacy
+# JSON bodies end-to-end (the interop path older clients ride). The wire
+# codec and REST server tests carry the equivalence matrix + keep-alive
+# accounting in both modes.
+JAX_PLATFORMS=cpu python -m pytest -q tests/test_wire.py tests/test_rest.py
+SDA_WIRE=json JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_wire.py tests/test_rest.py
+
 echo "=== ci 2/5: CLI acceptance walkthrough ==="
 sh scripts/simple-cli-example.sh
 

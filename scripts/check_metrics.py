@@ -2,7 +2,7 @@
 """CI gate: scrape /v1/metrics from a live REST stack and fail loudly if
 the exposition stops parsing or the core series disappear.
 
-Spins up the real ThreadingHTTPServer on a loopback port (same process,
+Spins up the real asyncio keep-alive REST server on a loopback port (same process,
 so the process-global registry is the one the server samples), drives a
 small genuine workload through every instrumented layer — HTTP requests,
 store writes, crypto seals (client participation), and a CPU secure_sum
@@ -66,6 +66,11 @@ REQUIRED_SERIES = [
     # the injected failures and the client's recoveries must both show
     "sda_fault_injections_total",
     "sda_rest_retries_total",
+    # binary wire plane: the workload's batch POST and chunk GETs ride
+    # application/x-sda-binary by default, so per-route timing and payload
+    # volume must both show with their wire labels
+    "sda_rest_route_seconds",
+    "sda_wire_bytes_total",
 ]
 
 

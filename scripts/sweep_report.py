@@ -34,6 +34,14 @@ with a scaling-efficiency column (speedup over the serial run divided by
 the worker count; 1.0 = perfect scaling) and the host cpu_count the run
 measured on.
 
+Also tabulates the wire-transport rider artifacts
+(``bench-artifacts/wire-<stamp>.json``, written by bench.py's
+measure_wire_transport): one row per run with the JSON-leg and binary-leg
+ingest rates measured over the same live keep-alive server, the
+binary-vs-json ratio, the ratio against the recorded ~11K/s pre-binary
+JSON baseline (the wire plane's acceptance bar), the clerking-fetch and
+reveal ratios, and whether server RSS stayed flat across the legs.
+
 Also rolls the churn harness's banked cells (``scenario-<name>-*.json``,
 written by scripts/scenarios.py) into the survivability matrix: scenario
 rows x (store, transport) columns, latest artifact per cell, OK / FAIL /
@@ -298,6 +306,60 @@ def print_committee(rows) -> None:
         )
 
 
+def load_wire(artdir: pathlib.Path):
+    """One row per wire-*.json artifact: both legs' ingest rates plus the
+    ratio columns (vs the same-run JSON leg and vs the recorded pre-binary
+    baseline)."""
+    rows = []
+    for f in sorted(artdir.glob("wire-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict):
+            continue
+        json_leg = d.get("json") if isinstance(d.get("json"), dict) else {}
+        binary_leg = d.get("binary") if isinstance(d.get("binary"), dict) else {}
+        if binary_leg.get("ingest_per_s") is None:
+            continue  # no rate: nothing to tabulate
+        rows.append(
+            {
+                "artifact": f.name,
+                "n": d.get("n_participants"),
+                "store": d.get("store"),
+                "json_ingest_per_s": json_leg.get("ingest_per_s"),
+                "binary_ingest_per_s": binary_leg.get("ingest_per_s"),
+                "vs_json": d.get("ingest_binary_vs_json"),
+                "vs_baseline": d.get("ingest_binary_vs_baseline"),
+                "fetch_ratio": d.get("clerking_fetch_binary_vs_json"),
+                "reveal_ratio": d.get("reveal_binary_vs_json"),
+                "rss_flat": d.get("rss_flat"),
+            }
+        )
+    return rows
+
+
+def print_wire(rows) -> None:
+    print("\nwire-transport riders (wire-*.json):")
+    print(
+        f"{'n':>7} {'store':>6} {'json/s':>8} {'binary/s':>9} {'vs_json':>8} "
+        f"{'vs_base':>8} {'fetch_x':>8} {'reveal_x':>8} {'rss':>5}  artifact"
+    )
+    for r in rows:
+        rss = "-" if r["rss_flat"] is None else ("flat" if r["rss_flat"] else "GREW")
+        print(
+            f"{r['n'] if r['n'] is not None else '-':>7} "
+            f"{r['store'] if r['store'] is not None else '-':>6} "
+            f"{r['json_ingest_per_s'] if r['json_ingest_per_s'] is not None else '-':>8} "
+            f"{r['binary_ingest_per_s']:>9} "
+            f"{r['vs_json'] if r['vs_json'] is not None else '-':>8} "
+            f"{r['vs_baseline'] if r['vs_baseline'] is not None else '-':>8} "
+            f"{r['fetch_ratio'] if r['fetch_ratio'] is not None else '-':>8} "
+            f"{r['reveal_ratio'] if r['reveal_ratio'] is not None else '-':>8} "
+            f"{rss:>5}  {r['artifact']}"
+        )
+
+
 def load_scenarios(artdir: pathlib.Path):
     """Latest record per (scenario, store, transport) cell from the churn
     harness's scenario-*.json artifacts (scripts/scenarios.py), plus any
@@ -390,6 +452,7 @@ def main() -> int:
     clerking_rows = load_clerking(artdir)
     reveal_rows = load_reveal(artdir)
     committee_rows = load_committee(artdir)
+    wire_rows = load_wire(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
         not rows
@@ -397,12 +460,13 @@ def main() -> int:
         and not clerking_rows
         and not reveal_rows
         and not committee_rows
+        and not wire_rows
         and not scenario_cells
     ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
-            f"reveal-*.json, committee-*.json, or scenario-*.json artifacts "
-            f"under {artdir}/",
+            f"reveal-*.json, committee-*.json, wire-*.json, or "
+            f"scenario-*.json artifacts under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -445,6 +509,8 @@ def main() -> int:
         print_reveal(reveal_rows)
     if committee_rows:
         print_committee(committee_rows)
+    if wire_rows:
+        print_wire(wire_rows)
     if scenario_cells:
         print_scenarios(scenario_cells, overhead_rows)
     return 0

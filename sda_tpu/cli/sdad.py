@@ -141,7 +141,15 @@ def main(argv=None) -> int:
     # instead of racing a probe-socket for a "free" port
     print(f"sdad: listening on {bound_host}:{bound_port}", flush=True)
     log.info("sda REST server listening on %s:%s", bound_host, bound_port)
-    httpd.serve_forever()
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        # keep-alive accounting: force-close live persistent connections
+        # instead of waiting out their idle timeout (SDA_REST_IDLE_TIMEOUT_S)
+        log.info("interrupted; closing live connections")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
     return 0
 
 

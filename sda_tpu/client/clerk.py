@@ -15,7 +15,10 @@ Download and compute overlap in a bounded pipeline — up to
 ``SDA_PREFETCH_DEPTH`` (default 3) range requests in flight while the
 main thread decrypts + folds the current chunk (client/prefetch.py) —
 so wall time approaches max(download, decrypt+combine) instead of their
-sum, with at most depth+1 chunks resident at once.
+sum, with at most depth+1 chunks resident at once. Chunk GETs ask for
+``application/x-sda-binary`` by default (one encryption frame per range
+— raw ciphertext bytes instead of base64'd JSON; ``SDA_WIRE=json``
+restores the legacy array bodies).
 """
 
 from __future__ import annotations

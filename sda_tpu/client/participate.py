@@ -12,6 +12,12 @@ aggregation, committee, and verified clerk keys are fetched once, every
 clerk share across the whole batch is sealed in one engine call
 (crypto.encrypt_share_matrix), and upload goes through the service's bulk
 ``create_participations`` — the client half of the batched ingest pipeline.
+
+Over REST, each batch upload is ONE keep-alive POST on the batch route,
+carried as an ``application/x-sda-binary`` frame by default (rest/wire.py
+packs ids as raw uuid bytes and sealed boxes as raw ciphertext bytes —
+no base64, no per-row JSON). ``SDA_WIRE=json`` pins the legacy JSON
+array body; either way the sealed bytes on the wire are identical.
 """
 
 from __future__ import annotations

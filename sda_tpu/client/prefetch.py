@@ -15,7 +15,10 @@ actual cursor. In-flight memory is bounded to depth+1 chunks.
 call sites validate and time the range read inside their fetch).
 Worker threads start with a fresh contextvars context, so the caller's
 trace id is rebound before each speculative fetch — chunk GETs keep
-carrying X-SDA-Trace.
+carrying X-SDA-Trace. The fetches themselves are wire-format agnostic:
+the REST binding negotiates ``application/x-sda-binary`` per request
+underneath, and each speculative GET rides its own pooled keep-alive
+connection, so depth-N prefetch means N pipelined binary chunk reads.
 """
 
 from __future__ import annotations

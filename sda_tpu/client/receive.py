@@ -19,7 +19,11 @@ so recipient memory stays flat in cohort size and wall time approaches
 max(download, decrypt+fold) instead of their sum. Small results keep the
 legacy bulk wire shape but route through the same accumulator as a
 single chunk, so both paths share one fold semantics (and are
-byte-identical — see tests/test_reveal_chunks.py).
+byte-identical — see tests/test_reveal_chunks.py). Both paged range
+routes (mask chunks and clerk-result chunks) are fetched as
+``application/x-sda-binary`` frames by default — raw ciphertext/uuid
+bytes instead of base64'd JSON — with ``SDA_WIRE=json`` pinning the
+legacy bodies.
 """
 
 from __future__ import annotations

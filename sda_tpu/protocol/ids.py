@@ -15,7 +15,7 @@ import uuid
 class TypedId:
     """A uuid wrapper with nominal typing; wire form is the hyphenated string."""
 
-    __slots__ = ("uuid",)
+    __slots__ = ("uuid", "_hash")
 
     def __init__(self, value=None):
         if value is None:
@@ -39,6 +39,19 @@ class TypedId:
         return cls(uuid.uuid4())
 
     @classmethod
+    def _from_uuid_bytes(cls, raw: bytes):
+        """Trusted bulk-decode path: build from 16 raw big-endian bytes,
+        bypassing the dispatching constructor and ``uuid.UUID.__init__``
+        (both profiled hot when a binary wire frame carries thousands of
+        id columns). Callers must guarantee ``len(raw) == 16``."""
+        u = object.__new__(uuid.UUID)
+        object.__setattr__(u, "int", int.from_bytes(raw, "big"))
+        object.__setattr__(u, "is_safe", uuid.SafeUUID.unknown)
+        self = object.__new__(cls)
+        self.uuid = u
+        return self
+
+    @classmethod
     def from_str(cls, s: str):
         return cls(s)
 
@@ -58,10 +71,17 @@ class TypedId:
         return f"{type(self).__name__}({str(self.uuid)!r})"
 
     def __eq__(self, other) -> bool:
-        return type(other) is type(self) and other.uuid == self.uuid
+        return type(other) is type(self) and other.uuid.int == self.uuid.int
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self.uuid))
+        # Ids are immutable and hashed constantly as store keys; cache the
+        # hash on first use rather than re-deriving the tuple each lookup.
+        try:
+            return self._hash
+        except AttributeError:
+            h = hash((type(self).__name__, self.uuid))
+            self._hash = h
+            return h
 
 
 class AgentId(TypedId):

@@ -97,6 +97,19 @@ class Encryption(_SodiumNewtype):
         tag, payload = _untag(obj, cls.VARIANTS)
         return cls(Binary.from_json(payload), variant=tag)
 
+    @classmethod
+    def _from_wire(cls, data: bytes, variant: str):
+        """Trusted bulk-decode path: wrap ciphertext bytes sliced out of a
+        validated binary frame, bypassing the isinstance-dispatching
+        constructors (profiled hot at thousands of ciphertexts per frame).
+        Callers must pass ``bytes`` and a tag from ``VARIANTS``."""
+        inner = object.__new__(Binary)
+        inner.data = data
+        self = object.__new__(cls)
+        self.inner = inner
+        self.variant = variant
+        return self
+
     def __eq__(self, other) -> bool:
         return (
             type(other) is type(self)

@@ -1,5 +1,7 @@
-"""sda_tpu.rest — the HTTP binding of the service seam (server + client)."""
+"""sda_tpu.rest — the HTTP binding of the service seam (server + client),
+plus the negotiated binary wire codec the hot routes ride (``wire``)."""
 
+from . import wire
 from .client import SdaHttpClient
 from .server import listen, make_handler, serve_background, serve_forever
 from .tokenstore import TokenStore
@@ -11,4 +13,5 @@ __all__ = [
     "make_handler",
     "serve_background",
     "serve_forever",
+    "wire",
 ]

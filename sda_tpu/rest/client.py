@@ -5,6 +5,16 @@ client-http/src/client.rs:173-370), decorating every authenticated request
 with Basic auth from the ``TokenStore``. Response protocol: 404 with the
 ``Resource-not-found`` header means ``None``; 401/403/400 map back to the
 protocol error types.
+
+Transport: one ``requests.Session`` with a 32-connection keep-alive pool,
+reused across the client's lifetime — the server side holds these
+connections open (HTTP/1.1 keep-alive), so a round is mostly zero-
+handshake. The hot bulk routes — the participation batch POST and the
+clerking-job / snapshot-result chunk GETs — default to the negotiated
+``application/x-sda-binary`` frames from ``rest/wire.py``; GETs advertise
+it via ``Accept`` and parse whatever Content-Type the server answers
+with, so a JSON-only server downgrades transparently. ``SDA_WIRE=json``
+forces the legacy JSON bodies on every route.
 """
 
 from __future__ import annotations
@@ -20,6 +30,7 @@ import requests
 
 from .. import telemetry
 from ..utils import faults
+from . import wire
 from ..protocol import (
     Agent,
     Aggregation,
@@ -107,8 +118,16 @@ class SdaHttpClient(SdaService):
     # -- plumbing -----------------------------------------------------------
 
     def _request(self, method: str, path: str, caller=None, body=None, params=None,
-                 idempotent: bool | None = None):
+                 idempotent: bool | None = None, raw_body: bytes | None = None,
+                 content_type: str | None = None, accept: str | None = None,
+                 raw: bool = False):
         """One protocol call, with transient-failure hardening.
+
+        ``raw_body``/``content_type`` send a pre-encoded body (the binary
+        wire frames) instead of a JSON one; ``accept`` advertises an
+        alternate response format; ``raw=True`` returns the
+        ``requests.Response`` on 2xx so the caller can negotiate on the
+        response Content-Type (``None``/error mapping is unchanged).
 
         ``idempotent=None`` (the default) retries GET/DELETE only. POST
         call sites whose server handlers are idempotent by construction
@@ -126,11 +145,16 @@ class SdaHttpClient(SdaService):
         auth = (str(caller.id), self.token_store.get()) if caller is not None else None
         data = None
         headers = {}
-        if body is not None:
+        if raw_body is not None:
+            data = raw_body
+            headers["Content-Type"] = content_type or wire.CONTENT_TYPE
+        elif body is not None:
             payload = body.to_json() if hasattr(body, "to_json") else body
             # compact, like the reference client's serde_json bodies
             data = json.dumps(payload, separators=(",", ":")).encode("utf-8")
             headers["Content-Type"] = "application/json"
+        if accept is not None:
+            headers["Accept"] = accept
         trace_id = telemetry.current_trace_id() if telemetry.enabled() else None
         if trace_id:
             # propagate the caller's trace id so server-side spans join it
@@ -184,7 +208,7 @@ class SdaHttpClient(SdaService):
                 method=method,
                 route=re.sub(r"[0-9a-fA-F-]{36}", "{id}", path),
             ).observe(time.perf_counter() - t0)
-        return self._process(resp)
+        return self._process(resp, raw=raw)
 
     @staticmethod
     def _count_retry(method: str, path: str, reason: str) -> None:
@@ -198,8 +222,10 @@ class SdaHttpClient(SdaService):
             ).inc()
 
     @staticmethod
-    def _process(resp) -> Optional[dict]:
+    def _process(resp, raw: bool = False):
         if resp.status_code in (200, 201):
+            if raw:
+                return resp if resp.content else None
             return resp.json() if resp.content else None
         if resp.status_code == 404:
             if "Resource-not-found" in resp.headers:
@@ -314,27 +340,46 @@ class SdaHttpClient(SdaService):
         )
         return None if obj is None else SnapshotResult.from_json(obj)
 
+    def _get_negotiated(self, path, caller, decode_binary, decode_json):
+        """A chunk GET that prefers the binary wire format: advertise it
+        via Accept (unless ``SDA_WIRE=json``), then parse by the response
+        Content-Type — a JSON-only server downgrades transparently."""
+        if wire.mode() != "binary":
+            obj = self._request("GET", path, caller)
+            return None if obj is None else decode_json(obj)
+        resp = self._request("GET", path, caller, accept=wire.CONTENT_TYPE, raw=True)
+        if resp is None:
+            return None
+        if wire.is_binary(resp.headers.get("Content-Type")):
+            try:
+                return decode_binary(resp.content)
+            except wire.WireError as e:
+                # a fully-delivered but undecodable frame is a server bug,
+                # not a transport blip — surface it, never half-decode
+                raise SdaError(f"undecodable binary response: {e}") from e
+        return decode_json(resp.json())
+
     def get_snapshot_result_masks(self, caller, aggregation_id, snapshot_id, start):
         from ..protocol import Encryption
 
-        obj = self._request(
-            "GET",
+        return self._get_negotiated(
             f"/v1/aggregations/{quote(str(aggregation_id))}/snapshots/"
             f"{quote(str(snapshot_id))}/result/masks/{int(start)}",
             caller,
+            wire.decode_encryptions,
+            lambda obj: [Encryption.from_json(e) for e in obj],
         )
-        return None if obj is None else [Encryption.from_json(e) for e in obj]
 
     def get_snapshot_result_clerks(self, caller, aggregation_id, snapshot_id, start):
         from ..protocol import ClerkingResult
 
-        obj = self._request(
-            "GET",
+        return self._get_negotiated(
             f"/v1/aggregations/{quote(str(aggregation_id))}/snapshots/"
             f"{quote(str(snapshot_id))}/result/clerks/{int(start)}",
             caller,
+            wire.decode_clerking_results,
+            lambda obj: [ClerkingResult.from_json(c) for c in obj],
         )
-        return None if obj is None else [ClerkingResult.from_json(c) for c in obj]
 
     # -- participation ------------------------------------------------------
 
@@ -346,14 +391,26 @@ class SdaHttpClient(SdaService):
         """Batched submit: the whole array in one request on the batch
         route — one auth check, one response, one store transaction —
         over the session's persistent keep-alive connection. Overrides
-        the interface's sequential (non-atomic) default."""
-        self._request(
-            "POST",
-            "/v1/aggregations/participations/batch",
-            caller,
-            [p.to_json() for p in participations],
-            idempotent=True,
-        )
+        the interface's sequential (non-atomic) default. The body is one
+        binary wire frame by default (columns of raw sealed boxes, no
+        base64, no per-field JSON); ``SDA_WIRE=json`` restores the legacy
+        JSON array for old servers."""
+        if wire.mode() == "binary":
+            self._request(
+                "POST",
+                "/v1/aggregations/participations/batch",
+                caller,
+                raw_body=wire.encode_participations(participations),
+                idempotent=True,
+            )
+        else:
+            self._request(
+                "POST",
+                "/v1/aggregations/participations/batch",
+                caller,
+                [p.to_json() for p in participations],
+                idempotent=True,
+            )
 
     # -- clerking -----------------------------------------------------------
 
@@ -364,12 +421,12 @@ class SdaHttpClient(SdaService):
     def get_clerking_job_chunk(self, caller, job_id, start):
         from ..protocol import Encryption
 
-        obj = self._request(
-            "GET",
+        return self._get_negotiated(
             f"/v1/aggregations/implied/jobs/{quote(str(job_id))}/chunks/{int(start)}",
             caller,
+            wire.decode_encryptions,
+            lambda obj: [Encryption.from_json(e) for e in obj],
         )
-        return None if obj is None else [Encryption.from_json(e) for e in obj]
 
     def create_clerking_result(self, caller, result) -> None:
         self._request(

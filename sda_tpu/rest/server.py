@@ -18,7 +18,8 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
     POST   /v1/aggregations/implied/committee
     GET    /v1/aggregations/{AggregationId}/committee
     POST   /v1/aggregations/participations
-    POST   /v1/aggregations/participations/batch   (additive; JSON array)
+    POST   /v1/aggregations/participations/batch   (additive; JSON array
+                              or one application/x-sda-binary frame)
     GET    /v1/aggregations/{AggregationId}/status
     POST   /v1/aggregations/implied/snapshot
     GET    /v1/aggregations/any/jobs
@@ -31,13 +32,21 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
     GET    /v1/metrics        (additive; unauthenticated Prometheus text)
     GET    /v1/metrics.json   (additive; unauthenticated telemetry snapshot)
 
+Wire negotiation (docs/protocol.md): the hot bulk routes — the
+participation batch POST and the three chunk GETs — speak
+``application/x-sda-binary`` (``rest/wire.py``) when the request asks
+for it via ``Content-Type`` / ``Accept``; every other request, and every
+legacy client, gets the byte-identical JSON bodies as before.
+
 Observability: every request gets a fresh id, echoed as
 ``X-SDA-Request-Id`` and stamped on 404/500 log lines; an incoming
-``X-SDA-Trace`` header is adopted for the handler thread (and echoed
-back), so server-side spans — dispatch, service, store — carry the
-client's trace id. Per-route request counts and latencies land in the
-telemetry registry under a normalized route template (uuid segments
-become ``{id}``). See docs/observability.md.
+``X-SDA-Trace`` header is adopted for the handler (and echoed back), so
+server-side spans — dispatch, service, store — carry the client's trace
+id. Per-route request counts and latencies land in the telemetry
+registry under a normalized route template (uuid segments become
+``{id}``), with the wire-format split tracked by
+``sda_rest_route_seconds{route,wire}`` and payload volume by
+``sda_wire_bytes_total{route,wire,direction}``. See docs/observability.md.
 
 Auth: HTTP Basic, username = AgentId, password = token recorded on first
 ``create_agent`` (trust-on-first-use, lib.rs:298-315). Missing resources are
@@ -45,25 +54,40 @@ Auth: HTTP Basic, username = AgentId, password = token recorded on first
 "no resource" from "no route" (lib.rs:338-343). Errors map to
 401 / 403 / 400 / 500 (lib.rs:112-117).
 
-Built on the stdlib ThreadingHTTPServer: one import, zero deps, adequate for
-a coordination plane whose heavy payloads are bulk base64 blobs (the math
-plane never crosses this boundary per element).
+Transport: an asyncio event-loop server speaking HTTP/1.1 with
+keep-alive (replacing the stdlib ThreadingHTTPServer, which burned one
+thread and usually one fresh connection per sporadic phone). Idle
+connections cost a coroutine, not a thread; request *handling* runs on a
+bounded executor pool (``SDA_REST_WORKERS``) because the service layer
+is synchronous by design. Keep-alive accounting: idle connections are
+reaped after ``SDA_REST_IDLE_TIMEOUT_S`` (default 60), and ``shutdown()``
+force-closes every live connection so teardown never waits out a
+persistent client. The public surface is ThreadingHTTPServer-shaped —
+``server_address``, ``serve_forever()``, ``shutdown()``,
+``server_close()`` — so ``sdad``, the bench riders, and the scenario
+harness did not have to change.
 """
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import contextlib
 import json
 import logging
+import os
 import re
+import socket
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
+from http import HTTPStatus
+from urllib.parse import unquote_plus
 
 from .. import telemetry
 from ..utils import faults
+from . import wire
 from ..protocol import (
     Agent,
     AgentId,
@@ -88,26 +112,110 @@ log = logging.getLogger("sda.rest.server")
 
 _UUID = r"[0-9a-fA-F-]{36}"
 
+#: request-header section cap per request (stdlib http.server allows 100
+#: headers; a byte cap is the same guard in keep-alive-friendly form)
+_MAX_HEADER_BYTES = 64 * 1024
 
-class _Handler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-    service = None  # SdaServerService, set by make_handler
 
-    # per-request observability state, reset by _dispatch
-    _request_id = None
-    _trace_id = None
-    _status = None
-    # set by an SDA_FAULTS "truncate" draw: _send then declares the full
-    # Content-Length but delivers only half the body
-    _truncate_body = False
+def _idle_timeout_s() -> float:
+    """How long a keep-alive connection may sit idle between requests
+    before the server reaps it (``SDA_REST_IDLE_TIMEOUT_S``, default 60).
+    Bounds the connection table against phones that connect once and
+    vanish; ``shutdown()`` does not wait for it — live connections are
+    force-closed at teardown."""
+    return max(0.05, float(os.environ.get("SDA_REST_IDLE_TIMEOUT_S", "60")))
+
+
+def _worker_count() -> int:
+    """Executor threads that run the (synchronous) service layer
+    (``SDA_REST_WORKERS``). Unlike the old thread-per-connection model
+    this bounds *active requests*, not open connections — thousands of
+    idle keep-alive phones cost coroutines only."""
+    env = os.environ.get("SDA_REST_WORKERS")
+    if env:
+        return max(1, int(env))
+    return max(8, min(32, (os.cpu_count() or 1) * 4))
+
+
+class _Response:
+    """One fully-assembled HTTP response, plus transport directives:
+    ``close`` ends the keep-alive stream after writing, ``truncate``
+    (fault injection) declares the full Content-Length but delivers half,
+    ``drop`` (fault injection) kills the connection with no bytes at all."""
+
+    __slots__ = ("status", "headers", "body", "close", "truncate", "drop")
+
+    def __init__(self, status=500, headers=(), body=b"", close=False,
+                 truncate=False, drop=False):
+        self.status = status
+        self.headers = list(headers)
+        self.body = body
+        self.close = close
+        self.truncate = truncate
+        self.drop = drop
+
+
+class Router:
+    """Transport-independent request handling: routing, auth, fault
+    injection, wire negotiation, error mapping, and telemetry. One
+    ``handle()`` call maps a fully-read request to a ``_Response`` —
+    the asyncio transport below feeds it, and tests can drive it
+    directly without a socket."""
+
+    #: request body cap — an authed client must not be able to stream
+    #: arbitrary gigabytes into server memory by claiming a huge
+    #: Content-Length. Sized ~30x the largest legitimate participation
+    #: we target (100K dims x 8 clerks ~= 15 MB of sealed JSON).
+    MAX_BODY_BYTES = 512 * 1024 * 1024
+
+    def __init__(self, service):
+        self.service = service
+
+    def handle(self, method: str, target: str, headers: dict,
+               body: bytes = b"", body_error: str | None = None) -> _Response:
+        """Handle one request. ``headers`` is lower-cased-key dict;
+        ``body`` is the fully-read request body; ``body_error`` is set by
+        the transport when the body could not be framed (bad or oversized
+        Content-Length) — the request must then 400 and the connection
+        must close, since the stream position is unknowable."""
+        if method not in ("GET", "POST", "DELETE"):
+            return _Response(501, [], b"Unsupported method", close=False)
+        ctx = _RequestContext(self.service, method, target, headers, body, body_error)
+        ctx.dispatch()
+        return ctx.response
+
+
+class _RequestContext:
+    """Per-request state and the route table (one instance per request)."""
+
+    def __init__(self, service, method, target, headers, body, body_error):
+        self.service = service
+        self.method = method
+        path, _, query = target.partition("?")
+        params = {}
+        for pair in query.split("&"):
+            if "=" in pair:
+                k, _, v = pair.partition("=")
+                params[k] = unquote_plus(v)
+        self.path = path
+        self.params = params
+        self.headers = headers
+        self.body = body
+        self.body_error = body_error
+        self.request_id = uuid.uuid4().hex[:16]
+        self.trace_id = None
+        self.status = None
+        #: which wire format served this request ("json" unless a binary
+        #: frame was read or written) — telemetry label only
+        self.wire = "json"
+        self._truncate_body = False
+        self._close = False
+        self.response = _Response()
 
     # -- plumbing -----------------------------------------------------------
 
-    def log_message(self, fmt, *args):
-        log.debug("%s " + fmt, self.address_string(), *args)
-
     def _auth_token(self):
-        header = (self.headers.get("Authorization") or "").strip()
+        header = (self.headers.get("authorization") or "").strip()
         if not header.startswith("Basic "):
             raise InvalidCredentialsError("Basic Authorization required")
         try:
@@ -120,30 +228,23 @@ class _Handler(BaseHTTPRequestHandler):
     def _caller(self) -> Agent:
         return self.service.server.check_auth_token(self._auth_token())
 
-    #: request body cap — an authed client must not be able to stream
-    #: arbitrary gigabytes into server memory by claiming a huge
-    #: Content-Length. Sized ~30x the largest legitimate participation
-    #: we target (100K dims x 8 clerks ~= 15 MB of sealed JSON).
-    MAX_BODY_BYTES = 512 * 1024 * 1024
-
-    def _read_json(self):
+    def _read_body(self) -> bytes:
         def refuse(msg):
-            # rejecting before draining the body would desync an HTTP/1.1
-            # keep-alive stream (the unread bytes become the "next
-            # request") — drop the connection after responding instead
-            self.close_connection = True
+            # the transport could not (or must not) frame the body, so
+            # the unread/unframed bytes would desync the keep-alive
+            # stream — drop the connection after responding
+            self._close = True
             raise InvalidRequestError(msg)
 
-        try:
-            length = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            refuse("invalid Content-Length")
-        if length <= 0:
+        if self.body_error:
+            refuse(self.body_error)
+        if not self.body:
             refuse("Expected a body")
-        if length > self.MAX_BODY_BYTES:
-            refuse(f"body exceeds the {self.MAX_BODY_BYTES}-byte limit")
+        return self.body
+
+    def _read_json(self):
         try:
-            return json.loads(self.rfile.read(length))
+            return json.loads(self._read_body())
         except json.JSONDecodeError as e:
             raise InvalidRequestError(f"malformed JSON body: {e}")
 
@@ -159,30 +260,24 @@ class _Handler(BaseHTTPRequestHandler):
             raise InvalidRequestError(f"malformed body: {e}")
 
     def _send(self, status: int, body: bytes = b"", headers=()):
-        self._status = status
-        self.send_response(status)
-        have_type = False
-        for k, v in headers:
-            have_type = have_type or k.lower() == "content-type"
-            self.send_header(k, v)
+        self.status = status
+        hs = list(headers)
+        have_type = any(k.lower() == "content-type" for k, _ in hs)
         if body and not have_type:
-            self.send_header("Content-Type", "application/json")
-        if self._request_id:
-            self.send_header("X-SDA-Request-Id", self._request_id)
-        if self._trace_id:
-            self.send_header(telemetry.TRACE_HEADER, self._trace_id)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        if body:
-            if self._truncate_body and len(body) > 1:
-                # injected truncation: the declared length stands, only
-                # half the bytes arrive, and the connection dies — the
-                # client's content read sees a short body (urllib3
-                # enforces Content-Length) and surfaces a transport error
-                self.wfile.write(body[: len(body) // 2])
-                self.close_connection = True
-            else:
-                self.wfile.write(body)
+            hs.append(("Content-Type", "application/json"))
+        if self.request_id:
+            hs.append(("X-SDA-Request-Id", self.request_id))
+        if self.trace_id:
+            hs.append((telemetry.TRACE_HEADER, self.trace_id))
+        resp = _Response(status, hs, bytes(body), close=self._close)
+        if self._truncate_body and len(body) > 1:
+            # injected truncation: the declared length stands, only half
+            # the bytes arrive, and the connection dies — the client's
+            # content read sees a short body (urllib3 enforces
+            # Content-Length) and surfaces a transport error
+            resp.truncate = True
+            resp.close = True
+        self.response = resp
 
     def _send_json_option(self, obj):
         if obj is None:
@@ -196,20 +291,17 @@ class _Handler(BaseHTTPRequestHandler):
                 200, json.dumps(payload, separators=(",", ":")).encode("utf-8")
             )
 
-    def _dispatch(self, method: str):
-        path, _, query = self.path.partition("?")
-        params = {}
-        for pair in query.split("&"):
-            if "=" in pair:
-                k, _, v = pair.partition("=")
-                from urllib.parse import unquote_plus
+    def _send_wire(self, frame: bytes):
+        """A negotiated binary response body (one x-sda-binary frame)."""
+        self.wire = "binary"
+        self._send(200, frame, headers=[("Content-Type", wire.CONTENT_TYPE)])
 
-                params[k] = unquote_plus(v)
+    def _wants_binary(self) -> bool:
+        return wire.accepts_binary(self.headers.get("accept"))
 
-        self._request_id = uuid.uuid4().hex[:16]
-        self._status = None
-        self._trace_id = None
-        self._truncate_body = False
+    # -- dispatch -----------------------------------------------------------
+
+    def dispatch(self):
         fault = faults.server_draw()
         if fault is not None:
             if fault.kind == "latency":
@@ -217,13 +309,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif fault.kind == "drop":
                 # connection death without an HTTP response; closing the
                 # keep-alive stream keeps the next request in sync
-                self.close_connection = True
+                self.response = _Response(drop=True, close=True)
                 return
             elif fault.kind == "e503":
-                # answering without draining a POST body would desync
-                # the keep-alive stream (see _read_json) — drop the
-                # connection after the response instead
-                self.close_connection = True
+                self._close = True
                 self._send(
                     503,
                     b"SDA_FAULTS: injected transient failure",
@@ -234,50 +323,79 @@ class _Handler(BaseHTTPRequestHandler):
             elif fault.kind == "truncate":
                 self._truncate_body = True
         if telemetry.enabled():
-            # adopt the client's trace id (or mint one) for this handler
-            # thread; echoed back by _send alongside the request id
-            self._trace_id = telemetry.sanitize_trace_id(
-                self.headers.get(telemetry.TRACE_HEADER)
+            # adopt the client's trace id (or mint one) for this handler;
+            # echoed back by _send alongside the request id
+            self.trace_id = telemetry.sanitize_trace_id(
+                self.headers.get(telemetry.TRACE_HEADER.lower())
             ) or telemetry.new_trace_id()
-            telemetry.set_trace_id(self._trace_id)
+            telemetry.set_trace_id(self.trace_id)
         t0 = time.perf_counter()
         try:
-            with telemetry.span("http.request", method=method) as span_record:
-                handled = self._dispatch_inner(method, path, params)
-                route = re.sub(_UUID, "{id}", path) if handled else "<unmatched>"
+            with telemetry.span("http.request", method=self.method) as span_record:
+                handled = self._dispatch_inner()
+                route = re.sub(_UUID, "{id}", self.path) if handled else "<unmatched>"
                 if span_record is not None:
                     span_record["attrs"] = {
-                        "method": method,
+                        "method": self.method,
                         "route": route,
-                        "status": self._status,
-                        "request_id": self._request_id,
+                        "status": self.status,
+                        "request_id": self.request_id,
                     }
             if telemetry.enabled():
+                elapsed = time.perf_counter() - t0
                 telemetry.histogram(
                     "sda_http_request_seconds",
                     "REST request latency by route template",
-                    method=method,
+                    method=self.method,
                     route=route,
-                ).observe(time.perf_counter() - t0)
+                ).observe(elapsed)
                 telemetry.counter(
                     "sda_http_requests_total",
                     "REST requests served by route template and status",
-                    method=method,
+                    method=self.method,
                     route=route,
-                    status=str(self._status or 0),
+                    status=str(self.status or 0),
                 ).inc()
+                # wire-plane split: route latency by negotiated format,
+                # and payload volume in each direction (docs/observability.md)
+                telemetry.histogram(
+                    "sda_rest_route_seconds",
+                    "REST route latency by route template and wire format",
+                    route=route,
+                    wire=self.wire,
+                ).observe(elapsed)
+                telemetry.counter(
+                    "sda_wire_bytes_total",
+                    "REST payload bytes by route, wire format, and direction",
+                    route=route,
+                    wire=self.wire,
+                    direction="in",
+                ).inc(len(self.body or b""))
+                telemetry.counter(
+                    "sda_wire_bytes_total",
+                    "REST payload bytes by route, wire format, and direction",
+                    route=route,
+                    wire=self.wire,
+                    direction="out",
+                ).inc(len(self.response.body))
         finally:
-            if self._trace_id is not None:
+            if self.trace_id is not None:
                 telemetry.set_trace_id(None)
 
-    def _dispatch_inner(self, method, path, params) -> bool:
+    def _dispatch_inner(self) -> bool:
         """Route + error mapping; returns whether the path was routed."""
         try:
-            handled = self._route(method, path, params)
+            if self.body_error:
+                # unframeable body (bad/oversized Content-Length): the
+                # stream position is unknowable, so 400 and close no
+                # matter which route was asked for
+                self._close = True
+                raise InvalidRequestError(self.body_error)
+            handled = self._route()
             if not handled:
                 log.error(
                     "route not found: %s %s (request %s)",
-                    method, path, self._request_id,
+                    self.method, self.path, self.request_id,
                 )
                 self._send(404)
             return handled
@@ -290,14 +408,15 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # ServerError and unexpected -> 500
             log.error(
                 "%s %s -> 500: %s (request %s)",
-                method, path, e, self._request_id,
+                self.method, self.path, e, self.request_id,
             )
             self._send(500, str(e).encode())
         return True  # an error from a handler still means the path routed
 
     # -- routes -------------------------------------------------------------
 
-    def _route(self, method, path, params) -> bool:
+    def _route(self) -> bool:
+        method, path, params = self.method, self.path, self.params
         m = lambda pat: re.fullmatch(pat, path)
         svc = self.service
 
@@ -403,17 +522,28 @@ class _Handler(BaseHTTPRequestHandler):
             return True
 
         if method == "POST" and path == "/v1/aggregations/participations/batch":
-            # batched ingest (additive route, not in the reference): a JSON
-            # array of participations, ONE auth check and ONE response for
-            # the whole batch — the transport half of the pipeline. The
-            # service layer accepts or rejects the array atomically.
-            payload = self._read_json()
-            if not isinstance(payload, list):
-                raise InvalidRequestError("expected a JSON array of participations")
-            try:
-                participations = [Participation.from_json(p) for p in payload]
-            except Exception as e:
-                raise InvalidRequestError(f"malformed body: {e}")
+            # batched ingest (additive route, not in the reference): one
+            # auth check, one response, one store transaction for the
+            # whole batch. Two negotiated body formats: the legacy JSON
+            # array, or one binary frame of varint-framed columns
+            # (Content-Type: application/x-sda-binary, rest/wire.py) that
+            # skips base64 + per-field JSON entirely. The service layer
+            # accepts or rejects the array atomically either way.
+            if wire.is_binary(self.headers.get("content-type")):
+                self.wire = "binary"
+                raw = self._read_body()
+                try:
+                    participations = wire.decode_participations(raw)
+                except wire.WireError as e:
+                    raise InvalidRequestError(f"malformed binary body: {e}")
+            else:
+                payload = self._read_json()
+                if not isinstance(payload, list):
+                    raise InvalidRequestError("expected a JSON array of participations")
+                try:
+                    participations = [Participation.from_json(p) for p in payload]
+                except Exception as e:
+                    raise InvalidRequestError(f"malformed body: {e}")
             svc.create_participations(self._caller(), participations)
             self._send(201)
             return True
@@ -439,13 +569,17 @@ class _Handler(BaseHTTPRequestHandler):
         ):
             # one ciphertext range of a paged clerking job; the clerk is
             # implied by auth (chunk reads answer 404 unless the caller
-            # owns the job). Response: bare JSON array of encryptions.
+            # owns the job). Response: bare JSON array of encryptions, or
+            # one binary encryption column when the request Accepts it.
             chunk = svc.get_clerking_job_chunk(
                 self._caller(), ClerkingJobId(match.group(1)), int(match.group(2))
             )
-            self._send_json_option(
-                None if chunk is None else [e.to_json() for e in chunk]
-            )
+            if chunk is not None and self._wants_binary():
+                self._send_wire(wire.encode_encryptions(chunk))
+            else:
+                self._send_json_option(
+                    None if chunk is None else [e.to_json() for e in chunk]
+                )
             return True
 
         if method == "POST" and (match := m(rf"/v1/aggregations/implied/jobs/({_UUID})/result")):
@@ -459,16 +593,20 @@ class _Handler(BaseHTTPRequestHandler):
             match := m(rf"/v1/aggregations/({_UUID})/snapshots/({_UUID})/result/masks/(\d+)")
         ):
             # one recipient-mask-encryption range of a paged snapshot
-            # result (recipient-only by ACL). Response: bare JSON array.
+            # result (recipient-only by ACL). Response: bare JSON array,
+            # or one binary encryption column when negotiated.
             chunk = svc.get_snapshot_result_masks(
                 self._caller(),
                 AggregationId(match.group(1)),
                 SnapshotId(match.group(2)),
                 int(match.group(3)),
             )
-            self._send_json_option(
-                None if chunk is None else [e.to_json() for e in chunk]
-            )
+            if chunk is not None and self._wants_binary():
+                self._send_wire(wire.encode_encryptions(chunk))
+            else:
+                self._send_json_option(
+                    None if chunk is None else [e.to_json() for e in chunk]
+                )
             return True
 
         if method == "GET" and (
@@ -481,9 +619,12 @@ class _Handler(BaseHTTPRequestHandler):
                 SnapshotId(match.group(2)),
                 int(match.group(3)),
             )
-            self._send_json_option(
-                None if chunk is None else [c.to_json() for c in chunk]
-            )
+            if chunk is not None and self._wants_binary():
+                self._send_wire(wire.encode_clerking_results(chunk))
+            else:
+                self._send_json_option(
+                    None if chunk is None else [c.to_json() for c in chunk]
+                )
             return True
 
         if method == "GET" and (
@@ -509,28 +650,233 @@ class _Handler(BaseHTTPRequestHandler):
 
         return False
 
-    def do_GET(self):
-        self._dispatch("GET")
 
-    def do_POST(self):
-        self._dispatch("POST")
+# -- transport --------------------------------------------------------------
 
-    def do_DELETE(self):
-        self._dispatch("DELETE")
+
+class SdaRestServer:
+    """Asyncio HTTP/1.1 keep-alive server around a ``Router``.
+
+    Mirrors the stdlib server surface the rest of the codebase already
+    uses: bind in the constructor (so ``server_address`` is final
+    immediately, port 0 included), ``serve_forever()`` blocks the calling
+    thread, ``shutdown()`` from any other thread stops it and returns
+    once the loop has exited, ``server_close()`` releases the socket.
+    """
+
+    def __init__(self, addr: tuple, service):
+        self.router = Router(service)
+        self._sock = socket.create_server(addr, backlog=128)
+        self.server_address = self._sock.getsockname()
+        self._loop = None
+        self._stop_event = None  # asyncio.Event, created on the loop
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+        self._shutdown_requested = threading.Event()
+        self._executor = None
+        self._writers = set()
+        self._conn_tasks = set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        if self._shutdown_requested.is_set():
+            self._stopped.set()
+            return
+        self._executor = ThreadPoolExecutor(
+            max_workers=_worker_count(), thread_name_prefix="sda-rest"
+        )
+        try:
+            asyncio.run(self._main())
+        finally:
+            self._started.set()  # unblock shutdown() even on startup failure
+            self._stopped.set()
+            self._executor.shutdown(wait=False)
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        # the default 64 KiB StreamReader buffer makes readexactly() of a
+        # multi-hundred-KB binary batch wake up dozens of times; a 1 MiB
+        # limit lets typical hot-route bodies arrive in a few reads
+        server = await asyncio.start_server(
+            self._handle_connection, sock=self._sock, limit=1 << 20
+        )
+        self._started.set()
+        if self._shutdown_requested.is_set():
+            self._stop_event.set()
+        try:
+            await self._stop_event.wait()
+        finally:
+            server.close()
+            # keep-alive accounting: force-close every live connection so
+            # teardown is prompt no matter how many phones are parked on
+            # open sockets (they reconnect-and-retry by contract)
+            for writer in list(self._writers):
+                with contextlib.suppress(Exception):
+                    writer.close()
+            with contextlib.suppress(Exception):
+                await server.wait_closed()
+            pending = [t for t in self._conn_tasks if not t.done()]
+            if pending:
+                await asyncio.wait(pending, timeout=5)
+
+    def shutdown(self) -> None:
+        """Stop ``serve_forever`` (thread-safe) and wait for it to exit,
+        closing live keep-alive connections rather than waiting them out."""
+        self._shutdown_requested.set()
+        if not self._started.wait(timeout=1):
+            return  # never started serving; nothing to unwind
+        if self._loop is not None and self._stop_event is not None:
+            with contextlib.suppress(RuntimeError):
+                self._loop.call_soon_threadsafe(self._stop_event.set)
+        self._stopped.wait(timeout=10)
+
+    def server_close(self) -> None:
+        with contextlib.suppress(OSError):
+            self._sock.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+
+    # -- connection handling ------------------------------------------------
+
+    async def _handle_connection(self, reader, writer):
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        self._writers.add(writer)
+        try:
+            await self._serve_connection(reader, writer)
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError,
+                TimeoutError, BrokenPipeError):
+            pass  # peer went away mid-request; nothing to answer
+        except Exception:
+            log.exception("connection handler failed")
+        finally:
+            self._writers.discard(writer)
+            self._conn_tasks.discard(task)
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    async def _serve_connection(self, reader, writer):
+        idle = _idle_timeout_s()
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                line = await asyncio.wait_for(reader.readline(), timeout=idle)
+            except (asyncio.TimeoutError, TimeoutError):
+                return  # idle keep-alive connection expired
+            if not line:
+                return  # clean EOF between requests
+            if line in (b"\r\n", b"\n"):
+                continue  # stray CRLF between requests (RFC 7230 §3.5)
+            try:
+                parts = line.decode("latin-1").strip().split()
+                method, target = parts[0], parts[1]
+                version = parts[2] if len(parts) > 2 else "HTTP/1.0"
+            except (IndexError, UnicodeDecodeError):
+                await self._write_response(
+                    writer, _Response(400, [], b"malformed request line", close=True)
+                )
+                return
+
+            headers = {}
+            header_bytes = 0
+            overflow = False
+            while True:
+                hline = await asyncio.wait_for(reader.readline(), timeout=idle)
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                header_bytes += len(hline)
+                if header_bytes > _MAX_HEADER_BYTES:
+                    overflow = True
+                    continue  # keep draining to the blank line, then reject
+                key, sep, value = hline.decode("latin-1").partition(":")
+                if sep:
+                    headers[key.strip().lower()] = value.strip()
+            if overflow:
+                await self._write_response(
+                    writer,
+                    _Response(431, [], b"request header section too large", close=True),
+                )
+                return
+
+            body = b""
+            body_error = None
+            raw_length = headers.get("content-length")
+            if headers.get("transfer-encoding"):
+                # no SDA client chunks uploads; without a Content-Length
+                # the stream cannot be reframed, so reject and close
+                body_error = "chunked request bodies are not supported"
+            elif raw_length is not None:
+                try:
+                    length = int(raw_length)
+                except ValueError:
+                    length = None
+                if length is None:
+                    body_error = "invalid Content-Length"
+                elif length > Router.MAX_BODY_BYTES:
+                    body_error = (
+                        f"body exceeds the {Router.MAX_BODY_BYTES}-byte limit"
+                    )
+                elif length > 0:
+                    if headers.get("expect", "").lower() == "100-continue":
+                        writer.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                    body = await asyncio.wait_for(
+                        reader.readexactly(length), timeout=idle
+                    )
+
+            response = await loop.run_in_executor(
+                self._executor, self.router.handle,
+                method, target, headers, body, body_error,
+            )
+            if response.drop:
+                return  # injected connection death: no bytes at all
+            if version != "HTTP/1.1" or headers.get("connection", "").lower() == "close":
+                response.close = True
+            await self._write_response(writer, response)
+            if response.close:
+                return
+
+    @staticmethod
+    async def _write_response(writer, response: _Response):
+        body = response.body
+        try:
+            reason = HTTPStatus(response.status).phrase
+        except ValueError:
+            reason = ""
+        head = [f"HTTP/1.1 {response.status} {reason}".rstrip()]
+        for k, v in response.headers:
+            head.append(f"{k}: {v}")
+        head.append(f"Content-Length: {len(body)}")
+        if response.close:
+            head.append("Connection: close")
+        payload = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+        if response.truncate and len(body) > 1:
+            payload += body[: len(body) // 2]
+            response.close = True
+        else:
+            payload += body
+        writer.write(payload)
+        await writer.drain()
+
+
+# -- module API (shape-compatible with the ThreadingHTTPServer era) ---------
 
 
 def make_handler(service):
-    return type("SdaHandler", (_Handler,), {"service": service})
+    """Compat shim from the ThreadingHTTPServer era: the 'handler' for a
+    service is now its transport-independent ``Router``."""
+    return Router(service)
 
 
-def listen(addr: tuple, service) -> ThreadingHTTPServer:
+def listen(addr: tuple, service) -> SdaRestServer:
     """Create (but do not start) an HTTP server bound to addr."""
-    return ThreadingHTTPServer(addr, make_handler(service))
+    return SdaRestServer(addr, service)
 
 
 def serve_forever(addr: tuple, service) -> None:
     httpd = listen(addr, service)
-    log.info("sda REST server listening on %s:%s", *addr)
+    log.info("sda REST server listening on %s:%s", *httpd.server_address[:2])
     httpd.serve_forever()
 
 

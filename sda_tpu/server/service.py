@@ -17,6 +17,7 @@ from ..protocol import (
     AggregationStatus,
     InvalidCredentialsError,
     InvalidRequestError,
+    PackedPaillierEncryptionScheme,
     PermissionDeniedError,
     Pong,
     SdaService,
@@ -191,24 +192,35 @@ class SdaServer:
                 )
         self.aggregation_store.create_committee(committee)
 
-    def _validate_participation(self, participation, committee, agg) -> None:
+    def _validate_participation(self, participation, committee, agg, expected=None) -> None:
         # Validate the clerk-encryption list against the committee: the
         # snapshot transpose routes ciphertexts to clerks *by position*
         # (stores.iter_snapshot_clerk_jobs_data), so a short/long/misordered
         # list would crash snapshotting or silently corrupt the aggregate.
         # (The reference accepts these unchecked — a deliberate hardening.)
+        # ``expected`` lets batched ingest hoist the committee's clerk list
+        # out of the per-item loop; it must equal the list derived here.
         if committee is None:
             raise InvalidRequestError("no committee for aggregation")
-        expected = [clerk for (clerk, _) in committee.clerks_and_keys]
-        got = [clerk for (clerk, _) in participation.clerk_encryptions]
-        if got != expected:
+        if expected is None:
+            expected = [clerk for (clerk, _) in committee.clerks_and_keys]
+        ce = participation.clerk_encryptions
+        if len(ce) != len(expected):
             raise InvalidRequestError(
                 "participation clerk encryptions do not match the committee"
             )
-        # clerk transport is sodium; a mis-tagged ciphertext would only
-        # surface as an opaque clerk-side decrypt failure later
-        if any(e.variant != "Sodium" for (_, e) in participation.clerk_encryptions):
-            raise InvalidRequestError("clerk encryptions must be sodium sealed boxes")
+        # one pass over the row: order against the committee, and clerk
+        # transport is sodium — a mis-tagged ciphertext would only surface
+        # as an opaque clerk-side decrypt failure later
+        for (clerk, e), want in zip(ce, expected):
+            if clerk != want:
+                raise InvalidRequestError(
+                    "participation clerk encryptions do not match the committee"
+                )
+            if e.variant != "Sodium":
+                raise InvalidRequestError(
+                    "clerk encryptions must be sodium sealed boxes"
+                )
         self._validate_recipient_encryption(participation, agg)
 
     def create_participation(self, participation) -> None:
@@ -226,12 +238,15 @@ class SdaServer:
         participations = list(participations)
         committees: dict = {}
         aggs: dict = {}
+        expected: dict = {}
         for p in participations:
             a = p.aggregation
             if a not in committees:
                 committees[a] = self.aggregation_store.get_committee(a)
                 aggs[a] = self.aggregation_store.get_aggregation(a)
-            self._validate_participation(p, committees[a], aggs[a])
+                if committees[a] is not None:
+                    expected[a] = [clerk for (clerk, _) in committees[a].clerks_and_keys]
+            self._validate_participation(p, committees[a], aggs[a], expected.get(a))
         self.aggregation_store.create_participations(participations)
 
     def _validate_recipient_encryption(self, participation, agg) -> None:
@@ -241,8 +256,6 @@ class SdaServer:
         time, after the participant's shares are in the aggregate — is
         rejected here. Sodium sealed boxes are opaque; only the variant tag
         can be checked."""
-        from ..protocol import PackedPaillierEncryptionScheme
-
         enc = participation.recipient_encryption
         if enc is None:
             return

@@ -352,3 +352,130 @@ def test_masked_round_survives_fault_storm(tmp_path, monkeypatch):
             assert injections > 0, counters
     finally:
         telemetry.reset()
+
+
+# -- the binary wire under the same fault plane -----------------------------
+
+
+def _small_round_setup(tmp_path, monkeypatch, service, masking=None):
+    """Committee + open aggregation + a pre-sealed batch, shared by the
+    binary-wire fault tests."""
+    from sda_fixtures import new_client, new_committee_setup
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        NoMasking,
+        SodiumEncryptionScheme,
+    )
+
+    dim, modulus = 4, 433
+    recipient, rkey, clerks = new_committee_setup(tmp_path, service, n_clerks=3)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="binary-faults",
+        vector_dimension=dim,
+        modulus=modulus,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=masking or NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=3, modulus=modulus),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+    participant = new_client(tmp_path / "participant", service)
+    participant.upload_agent()
+    values = [[i, i + 1, 2, 0] for i in range(5)]
+    batch = participant.new_participations(values, agg.id)
+    return recipient, clerks, participant, agg, values, batch
+
+
+@pytest.mark.parametrize("wire_env", ["json", "binary"])
+def test_faults_inject_identically_on_batch_route(tmp_path, monkeypatch, wire_env):
+    """drop / e503 / latency must hit the participation batch POST the
+    same way whichever body format rides it: identical error classes
+    after budget exhaustion, identical recovery once the plane lifts."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_WIRE", wire_env)
+    monkeypatch.setenv("SDA_REST_RETRIES", "2")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.005")
+    with serve_background(new_mem_server()) as url:
+        service = SdaHttpClient(url, TokenStore(str(tmp_path / "tokens")))
+        _rec, _clerks, participant, _agg, _values, batch = _small_round_setup(
+            tmp_path, monkeypatch, service
+        )
+        monkeypatch.setenv("SDA_FAULTS", "drop=1.0:5")
+        with pytest.raises(SdaError, match="transport failure"):
+            participant.upload_participations(batch)
+        monkeypatch.setenv("SDA_FAULTS", "e503=1.0:5")
+        with pytest.raises(SdaError, match="503"):
+            participant.upload_participations(batch)
+        monkeypatch.setenv("SDA_FAULTS", "latency=1.0@0.05:5")
+        t0 = time.perf_counter()
+        participant.upload_participations(batch)  # delayed, not failed
+        assert time.perf_counter() - t0 >= 0.04
+        monkeypatch.delenv("SDA_FAULTS")
+        participant.upload_participations(batch)  # plane off: healthy
+
+
+def test_truncated_binary_bodies_are_retried_never_half_decoded(
+    tmp_path, monkeypatch
+):
+    """A paged masked round on the binary wire under a truncation storm:
+    every truncated frame trips the transport length check BEFORE the
+    codec sees it (a WireError would surface as 'undecodable binary
+    response', which must never happen), the chunk is re-fetched, and
+    the reveal is exact."""
+    from sda_tpu.protocol import FullMasking
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_WIRE", "binary")
+    monkeypatch.setenv("SDA_REST_RETRIES", "8")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.002")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.05")
+    monkeypatch.setenv("SDA_JOB_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_JOB_CHUNK_SIZE", "2")
+    monkeypatch.setenv("SDA_RESULT_PAGE_THRESHOLD", "0")
+    monkeypatch.setenv("SDA_RESULT_CHUNK_SIZE", "2")
+    monkeypatch.setenv("SDA_TELEMETRY", "1")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with serve_background(new_mem_server()) as url:
+            service = SdaHttpClient(url, TokenStore(str(tmp_path / "tokens")))
+            recipient, clerks, participant, agg, values, batch = _small_round_setup(
+                tmp_path, monkeypatch, service, masking=FullMasking(modulus=433)
+            )
+            monkeypatch.setenv("SDA_FAULTS", "truncate=0.3:7")
+            participant.upload_participations(batch)
+            recipient.end_aggregation(agg.id)
+            for clerk in clerks:
+                clerk.run_chores(-1)
+            out = recipient.reveal_aggregation(agg.id).positive().values
+            expected = [sum(v[d] for v in values) % agg.modulus for d in range(4)]
+            np.testing.assert_array_equal(out, expected)
+
+            counters = telemetry.snapshot(include_spans=0)["counters"]
+            injections = sum(
+                c["value"]
+                for c in counters
+                if c["name"] == "sda_fault_injections_total"
+                and c["labels"].get("kind") == "truncate"
+            )
+            retries = sum(
+                c["value"] for c in counters if c["name"] == "sda_rest_retries_total"
+            )
+            assert injections > 0, counters
+            assert retries > 0, counters
+    finally:
+        telemetry.reset()

@@ -248,3 +248,91 @@ def test_transport_failures_are_sda_errors(tmp_path):
     client = SdaHttpClient("http://127.0.0.1:1", TokenStore(tmp_path), timeout=2)
     with pytest.raises(SdaError, match="transport failure"):
         client.ping()
+
+
+# -- keep-alive connection accounting ---------------------------------------
+
+
+def test_shutdown_is_prompt_with_live_keepalive_connections(tmp_path):
+    """Teardown must never wait out open persistent connections: with a
+    pooled client parked on a keep-alive socket AND a raw idle socket
+    connected, shutdown() force-closes both and returns in well under
+    the idle timeout."""
+    import socket
+    import threading
+    import time
+    from urllib.parse import urlparse
+
+    from sda_tpu.rest.server import listen
+
+    httpd = listen(("127.0.0.1", 0), new_mem_server())
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    host, port = httpd.server_address[:2]
+    base_url = f"http://{host}:{port}"
+    try:
+        # a pooled keep-alive client with a live connection in its pool
+        service = SdaHttpClient(base_url, TokenStore(tmp_path))
+        assert service.ping().running
+        # plus a raw socket parked on an ACCEPTED keep-alive connection
+        # (one full request served, then silence)
+        parked = socket.create_connection((host, port), timeout=10)
+        try:
+            parked.sendall(b"GET /v1/ping HTTP/1.1\r\nHost: x\r\n\r\n")
+            parked.settimeout(5)
+            assert parked.recv(4096).startswith(b"HTTP/1.1 200")
+
+            t0 = time.perf_counter()
+            httpd.shutdown()
+            httpd.server_close()
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 5.0, f"shutdown took {elapsed:.1f}s"
+            thread.join(timeout=5)
+            assert not thread.is_alive()
+            # the parked connection is really gone: EOF or a reset, not
+            # a hang until the idle timeout
+            try:
+                assert parked.recv(1) == b""
+            except ConnectionError:
+                pass
+        finally:
+            parked.close()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_idle_keepalive_connections_are_reaped(tmp_path, monkeypatch):
+    """SDA_REST_IDLE_TIMEOUT_S bounds how long a silent persistent
+    connection may hold a socket: after one served request the
+    connection stays open for reuse, then the reaper closes it once the
+    idle window passes."""
+    import socket
+    import time
+
+    monkeypatch.setenv("SDA_REST_IDLE_TIMEOUT_S", "0.2")
+    with serve_background(new_mem_server()) as base_url:
+        from urllib.parse import urlparse
+
+        parsed = urlparse(base_url)
+        with socket.create_connection(
+            (parsed.hostname, parsed.port), timeout=10
+        ) as s:
+            s.sendall(
+                b"GET /v1/ping HTTP/1.1\r\n"
+                + f"Host: {parsed.hostname}\r\n\r\n".encode()
+            )
+            s.settimeout(5)
+            first = s.recv(4096)
+            assert first.startswith(b"HTTP/1.1 200")
+            # no Connection: close — the server kept the socket open ...
+            assert b"connection: close" not in first.lower()
+            # ... until the idle window expires and the reaper ends it
+            t0 = time.perf_counter()
+            rest = b""
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                rest += chunk
+            assert time.perf_counter() - t0 < 5.0

@@ -186,6 +186,42 @@ def test_committee_rider_section(tmp_path, capsys):
     assert "committee-broken.json" not in out
 
 
+def test_wire_rider_section(tmp_path, capsys):
+    _write(tmp_path, "wire-20260805-060000.json",
+           {"metric": "wire_transport", "n_participants": 3000,
+            "chunk_size": 512, "store": "mem",
+            "json": {"ingest_per_s": 17616, "clerking_fetch_per_s": 133333,
+                     "reveal_per_s": 22305, "peak_rss_mib": 75.5},
+            "binary": {"ingest_per_s": 59524, "clerking_fetch_per_s": 181818,
+                       "reveal_per_s": 22676, "peak_rss_mib": 68.5},
+            "json_baseline_per_s": 11000,
+            "ingest_binary_vs_baseline": 5.41,
+            "ingest_binary_vs_json": 3.38,
+            "clerking_fetch_binary_vs_json": 1.36,
+            "reveal_binary_vs_json": 1.02,
+            "rss_flat": True})
+    # legacy shape without the baseline columns: kept, gaps dashed
+    _write(tmp_path, "wire-20260805-050000.json",
+           {"metric": "wire_transport",
+            "binary": {"ingest_per_s": 40000}})
+    _write(tmp_path, "wire-broken.json", {"note": "no legs"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # wire rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "wire-transport riders" in out
+    assert "wire-20260805-060000.json" in out
+    assert "59524" in out and "17616" in out  # both legs' ingest rates
+    assert "5.41" in out  # the acceptance ratio vs the recorded baseline
+    assert "flat" in out
+    assert "wire-20260805-050000.json" in out  # legacy row kept, dashed
+    assert "wire-broken.json" not in out
+
+
 def test_scenario_survivability_section(tmp_path, capsys):
     _write(tmp_path, "scenario-vanish-after-sharing-20260805-050000-mem-rest.json",
            {"scenario": "vanish-after-sharing", "store": "mem",
