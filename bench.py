@@ -803,14 +803,9 @@ class _RssSampler:
 
     @staticmethod
     def _rss_kib() -> int:
-        try:
-            with open("/proc/self/status") as fh:
-                for line in fh:
-                    if line.startswith("VmRSS:"):
-                        return int(line.split()[1])
-        except OSError:
-            pass
-        return 0
+        from sda_tpu.telemetry.timeseries import read_rss_kib
+
+        return read_rss_kib()
 
     def __enter__(self):
         self.peak_kib = self._rss_kib()
@@ -2845,31 +2840,39 @@ def main() -> int:
             _CRYPTO_STATS.update(measure_rest_ingest())
     except Exception as exc:
         print(f"[bench] rest-ingest bench failed: {exc}", file=sys.stderr)
-    try:
-        with stage("batched-ingest rider"):
-            _CRYPTO_STATS["ingest"] = measure_batched_ingest()
-    except Exception as exc:
-        print(f"[bench] batched-ingest rider failed: {exc}", file=sys.stderr)
-    try:
-        with stage("wire-transport rider"):
-            _CRYPTO_STATS["wire"] = measure_wire_transport()
-    except Exception as exc:
-        print(f"[bench] wire-transport rider failed: {exc}", file=sys.stderr)
-    try:
-        with stage("clerking-pipeline rider"):
-            _CRYPTO_STATS["clerking"] = measure_clerking_pipeline()
-    except Exception as exc:
-        print(f"[bench] clerking-pipeline rider failed: {exc}", file=sys.stderr)
-    try:
-        with stage("reveal-pipeline rider"):
-            _CRYPTO_STATS["reveal"] = measure_reveal_pipeline()
-    except Exception as exc:
-        print(f"[bench] reveal-pipeline rider failed: {exc}", file=sys.stderr)
-    try:
-        with stage("committee-scaling rider"):
-            _CRYPTO_STATS["committee"] = measure_committee_scaling()
-    except Exception as exc:
-        print(f"[bench] committee-scaling rider failed: {exc}", file=sys.stderr)
+    # the five protocol-plane riders each drive full REST rounds (~30s of
+    # wall on one core across the set); SDA_BENCH_RIDERS=0 skips them so
+    # callers that only need the device metric line (the CLI acceptance
+    # children) don't pay for measurements they never read
+    if os.environ.get("SDA_BENCH_RIDERS") == "0":
+        print("[bench] protocol-plane riders skipped (SDA_BENCH_RIDERS=0)",
+              file=sys.stderr)
+    else:
+        try:
+            with stage("batched-ingest rider"):
+                _CRYPTO_STATS["ingest"] = measure_batched_ingest()
+        except Exception as exc:
+            print(f"[bench] batched-ingest rider failed: {exc}", file=sys.stderr)
+        try:
+            with stage("wire-transport rider"):
+                _CRYPTO_STATS["wire"] = measure_wire_transport()
+        except Exception as exc:
+            print(f"[bench] wire-transport rider failed: {exc}", file=sys.stderr)
+        try:
+            with stage("clerking-pipeline rider"):
+                _CRYPTO_STATS["clerking"] = measure_clerking_pipeline()
+        except Exception as exc:
+            print(f"[bench] clerking-pipeline rider failed: {exc}", file=sys.stderr)
+        try:
+            with stage("reveal-pipeline rider"):
+                _CRYPTO_STATS["reveal"] = measure_reveal_pipeline()
+        except Exception as exc:
+            print(f"[bench] reveal-pipeline rider failed: {exc}", file=sys.stderr)
+        try:
+            with stage("committee-scaling rider"):
+                _CRYPTO_STATS["committee"] = measure_committee_scaling()
+        except Exception as exc:
+            print(f"[bench] committee-scaling rider failed: {exc}", file=sys.stderr)
     # fail fast on an unreachable backend: the wedged-tunnel failure mode
     # (the axon relay can block jax.devices() for hours) would otherwise
     # eat the whole --deadline before the watchdog reports it. The probe

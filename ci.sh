@@ -18,7 +18,9 @@
 #      client workload + engine step against a loopback REST stack, then
 #      fails unless the exposition parses and every core series
 #      (request/crypto/store/engine) is present with the run's trace id
-#      visible in server-side spans
+#      visible in server-side spans; then a ~20s load_soak.py smoke whose
+#      banked artifact (exact rounds + monotonic sampler series) must
+#      render through scripts/trace_report.py
 #   4. examples/ — both runnable end-to-end demos (federated training,
 #      federated analytics) must keep running as documented
 #   5. scripts/scenarios.py — churn-scenario smoke over the real REST
@@ -60,6 +62,31 @@ sh scripts/simple-cli-example.sh
 
 echo "=== ci 3/5: telemetry exposition gate (live /v1/metrics scrape) ==="
 JAX_PLATFORMS=cpu python scripts/check_metrics.py
+
+echo "=== ci 3b/5: sustained-soak smoke (paced rounds + live sampler) ==="
+# ~20 s of paced rounds against the live loopback REST plane with the
+# time-series sampler ticking every second: the banked artifact must
+# parse, hold a monotonic sample series, and record every round as
+# byte-exact — then the flight recorder must render a round timeline
+# from the same artifact (the soak -> trace_report pipeline end-to-end)
+SOAK_ART="$(mktemp -d)"
+JAX_PLATFORMS=cpu python scripts/load_soak.py \
+    --duration 20 --rate 40 --round-size 80 --interval 1 --ab-rounds 0 \
+    --artifacts "$SOAK_ART"
+python - "$SOAK_ART" <<'EOF'
+import json, pathlib, sys
+arts = sorted(pathlib.Path(sys.argv[1]).glob("soak-*.json"))
+assert len(arts) == 1, f"expected one soak artifact, found {arts}"
+d = json.loads(arts[0].read_text())
+ts = [s["t"] for s in d["samples"]]
+assert len(ts) >= 10, f"expected >=10 sampler windows, got {len(ts)}"
+assert ts == sorted(ts) and len(set(ts)) == len(ts), "sample series not monotonic"
+assert d["total_rounds"] >= 1 and d["exact_rounds"] == d["total_rounds"], \
+    f"inexact rounds: {d['exact_rounds']}/{d['total_rounds']}"
+print(f"ci: soak banked {d['total_rounds']} exact rounds, {len(ts)} samples")
+EOF
+JAX_PLATFORMS=cpu python scripts/trace_report.py "$SOAK_ART"/soak-*.json
+rm -rf "$SOAK_ART"
 
 echo "=== ci 4/5: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Regression gate over banked bench artifacts: newest vs previous, per
+rider family.
+
+``bench.py`` and the rider scripts bank stamped JSON artifacts
+(``wire-<stamp>.json``, ``committee-<stamp>.json``, ``ingest-<stamp>.json``,
+``soak-<stamp>.json``, ...) but nothing *compares* runs — a quiet 20%
+ingest regression survives until someone eyeballs two sweep reports.
+This script closes the loop: for each rider family it takes the two
+newest artifacts, extracts that family's throughput metrics (higher is
+better), and exits nonzero when any metric regressed by more than
+``--threshold`` percent (default 15 — wide enough for shared-runner
+noise, narrow enough to catch a real cliff).
+
+Families with fewer than two artifacts are reported as ``n/a`` and never
+fail the gate; latency/RSS columns are deliberately out of scope (they
+live in sweep_report.py) — this gate is throughput-only so a slower-but-
+correct change can't hide behind an unrelated column.
+
+Usage:
+  python scripts/bench_compare.py [artifacts-dir] [--threshold 15]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def _metrics_ingest(d: dict) -> dict:
+    out = {}
+    for k in ("seal_batch_per_s", "build_per_s", "participate_many_per_s",
+              "rest_sqlite_batch_per_s", "rest_mem_batch_per_s"):
+        if isinstance(d.get(k), (int, float)):
+            out[k] = float(d[k])
+    return out
+
+
+def _metrics_wire(d: dict) -> dict:
+    out = {}
+    for leg in ("json", "binary"):
+        cfg = d.get(leg)
+        if isinstance(cfg, dict) and isinstance(
+            cfg.get("ingest_per_s"), (int, float)
+        ):
+            out[f"{leg}_ingest_per_s"] = float(cfg["ingest_per_s"])
+    return out
+
+
+def _metrics_committee(d: dict) -> dict:
+    """Best rate per plane — worker sweeps differ run to run, so compare
+    the envelope rather than pairing up individual worker counts."""
+    out = {}
+    planes = d.get("planes") if isinstance(d.get("planes"), dict) else {}
+    for plane, configs in planes.items():
+        if not isinstance(configs, dict):
+            continue
+        rates = [
+            cfg["per_s"] for cfg in configs.values()
+            if isinstance(cfg, dict) and isinstance(cfg.get("per_s"), (int, float))
+        ]
+        if rates:
+            out[f"{plane}_best_per_s"] = float(max(rates))
+    pool = d.get("read_pool") if isinstance(d.get("read_pool"), dict) else {}
+    rates = [
+        cfg["reads_per_s"] for cfg in pool.values()
+        if isinstance(cfg, dict) and isinstance(cfg.get("reads_per_s"), (int, float))
+    ]
+    if rates:
+        out["read_pool_best_per_s"] = float(max(rates))
+    return out
+
+
+def _metrics_pipeline(d: dict) -> dict:
+    """clerking-*/reveal-*: best encryption rate across delivery configs."""
+    configs = d.get("configs") if isinstance(d.get("configs"), dict) else {}
+    rates = [
+        cfg["encryptions_per_s"] for cfg in configs.values()
+        if isinstance(cfg, dict)
+        and isinstance(cfg.get("encryptions_per_s"), (int, float))
+    ]
+    return {"best_encryptions_per_s": float(max(rates))} if rates else {}
+
+
+def _metrics_soak(d: dict) -> dict:
+    out = {}
+    summary = d.get("summary") if isinstance(d.get("summary"), dict) else {}
+    if isinstance(summary.get("rps_mean"), (int, float)):
+        out["rps_mean"] = float(summary["rps_mean"])
+    return out
+
+
+#: family -> (glob, throughput extractor); sorted() over the stamped
+#: names is chronological, so [-1] is newest and [-2] its predecessor
+RIDERS = {
+    "ingest": ("ingest-*.json", _metrics_ingest),
+    "clerking": ("clerking-*.json", _metrics_pipeline),
+    "reveal": ("reveal-*.json", _metrics_pipeline),
+    "committee": ("committee-*.json", _metrics_committee),
+    "wire": ("wire-*.json", _metrics_wire),
+    "soak": ("soak-*.json", _metrics_soak),
+}
+
+
+def _load(path: pathlib.Path):
+    try:
+        d = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    return d if isinstance(d, dict) else None
+
+
+def compare_family(artdir: pathlib.Path, family: str, threshold_pct: float):
+    """Rows for one family: [{metric, prev, new, delta_pct, regressed}].
+
+    Returns (rows, prev_name, new_name); rows is None when there is no
+    newest/previous pair (or no comparable metric survives extraction).
+    """
+    glob, extract = RIDERS[family]
+    docs = []
+    for f in sorted(artdir.glob(glob)):
+        d = _load(f)
+        if d is None:
+            continue
+        metrics = extract(d)
+        if metrics:
+            docs.append((f.name, metrics))
+    if len(docs) < 2:
+        return None, None, None
+    (prev_name, prev), (new_name, new) = docs[-2], docs[-1]
+    rows = []
+    for metric in sorted(set(prev) & set(new)):
+        if prev[metric] <= 0:
+            continue
+        delta_pct = (new[metric] - prev[metric]) / prev[metric] * 100.0
+        rows.append(
+            {
+                "metric": metric,
+                "prev": prev[metric],
+                "new": new[metric],
+                "delta_pct": round(delta_pct, 2),
+                "regressed": delta_pct < -threshold_pct,
+            }
+        )
+    if not rows:
+        return None, None, None
+    return rows, prev_name, new_name
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("artdir", nargs="?", default="bench-artifacts")
+    ap.add_argument("--threshold", type=float, default=15.0,
+                    help="max tolerated throughput drop, percent (default 15)")
+    args = ap.parse_args()
+    artdir = pathlib.Path(args.artdir)
+
+    regressions = 0
+    compared = 0
+    print(f"throughput gate: newest vs previous, threshold -{args.threshold:g}%")
+    for family in RIDERS:
+        rows, prev_name, new_name = compare_family(
+            artdir, family, args.threshold
+        )
+        if rows is None:
+            print(f"\n{family}: n/a (fewer than two comparable artifacts)")
+            continue
+        compared += 1
+        print(f"\n{family}: {prev_name} -> {new_name}")
+        print(f"  {'metric':<28} {'prev':>12} {'new':>12} {'delta%':>8}")
+        for r in rows:
+            flag = "  REGRESSED" if r["regressed"] else ""
+            print(f"  {r['metric']:<28} {r['prev']:>12.3f} {r['new']:>12.3f} "
+                  f"{r['delta_pct']:>+8.2f}{flag}")
+            regressions += r["regressed"]
+
+    if not compared:
+        print(f"\nnothing to compare under {artdir}/ "
+              f"(need two artifacts of some family)", file=sys.stderr)
+        return 0  # an empty bench dir is not a regression
+    if regressions:
+        print(f"\n{regressions} metric(s) regressed more than "
+              f"{args.threshold:g}%", file=sys.stderr)
+        return 1
+    print("\nno throughput regressions beyond threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -71,6 +71,10 @@ REQUIRED_SERIES = [
     # volume must both show with their wire labels
     "sda_rest_route_seconds",
     "sda_wire_bytes_total",
+    # observability plane: the time-series sampler rides serve_background
+    # (SDA_TS defaults on) and must have banked at least one window by
+    # scrape time — main() shrinks the interval and waits for the tick
+    "sda_ts_samples_total",
 ]
 
 
@@ -209,8 +213,51 @@ def check_exposition(text: str) -> list:
     return errors
 
 
+def check_observability_routes(base_url: str) -> list:
+    """Scrape the observability plane the way a dashboard would: the
+    sampler window over /v1/metrics/history must hold >= 1 banked sample
+    and /v1/healthz must answer ok — both live, over HTTP."""
+    import json
+    import time
+
+    errors = []
+    try:
+        deadline = time.monotonic() + 10.0
+        while True:
+            with urllib.request.urlopen(
+                f"{base_url}/v1/metrics/history", timeout=30
+            ) as resp:
+                hist = json.loads(resp.read().decode("utf-8"))
+            if hist.get("samples") or time.monotonic() > deadline:
+                break
+            time.sleep(0.1)
+        if not hist.get("running"):
+            errors.append("/v1/metrics/history: sampler not running "
+                          "(serve_background should autostart it)")
+        if not hist.get("samples"):
+            errors.append("/v1/metrics/history: no samples banked within 10s")
+        else:
+            sample = hist["samples"][-1]
+            missing = {"t", "dt_s", "rss_mib", "routes"} - set(sample)
+            if missing:
+                errors.append(f"/v1/metrics/history: sample missing {missing}")
+    except Exception as e:
+        errors.append(f"/v1/metrics/history scrape failed: {e}")
+    try:
+        with urllib.request.urlopen(f"{base_url}/v1/healthz", timeout=30) as resp:
+            health = json.loads(resp.read().decode("utf-8"))
+        if health.get("status") != "ok":
+            errors.append(f"/v1/healthz answered {health!r}")
+    except Exception as e:
+        errors.append(f"/v1/healthz scrape failed: {e}")
+    return errors
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # a sub-second sampler interval so at least one time-series window is
+    # banked (and sda_ts_samples_total sampled) before the scrape
+    os.environ.setdefault("SDA_TS_INTERVAL_S", "0.2")
     from sda_tpu import telemetry
     from sda_tpu.rest import serve_background
     from sda_tpu.server import new_mem_server
@@ -225,11 +272,12 @@ def main() -> int:
             drive_workload(base_url, tmp)
         drive_faulted_leg(base_url, tmp)
         drive_engine()
+        observability_errors = check_observability_routes(base_url)
         with urllib.request.urlopen(f"{base_url}/v1/metrics", timeout=30) as resp:
             content_type = resp.headers.get("Content-Type", "")
             body = resp.read().decode("utf-8")
 
-    errors = check_exposition(body)
+    errors = check_exposition(body) + observability_errors
     if not content_type.startswith("text/plain"):
         errors.append(f"unexpected Content-Type: {content_type!r}")
     if not telemetry.spans(name="store.", trace_id="ci-check-metrics"):

@@ -1,0 +1,376 @@
+"""Sustained-load soak rider (ROADMAP item 2): hold a pinned arrival
+rate against a live loopback REST server and bank the longitudinal
+evidence.
+
+Drives full participation rounds — register once, then per round: build
+a fresh aggregation, submit ``--round-size`` participations at a pinned
+``--rate`` (participations/s, paced per submission like sporadic
+phones), cut the snapshot, run the clerks through the PAGED pipeline,
+reveal, and assert the aggregate is byte-exact — for ``--duration``
+seconds, with the time-series sampler scraping the shared process-global
+registry every ``--interval`` seconds.
+
+Banks ``soak-<stamp>.json`` into the artifact dir with:
+
+- ``samples``: the sampler's full window — per-route throughput and
+  windowed p50/p95/p99, store-op rates, wire bytes/s, RSS, rate
+  counters — the throughput/p99/RSS-over-time series ROADMAP item 2
+  asks for;
+- ``rounds``: per-round trace id, achieved arrival rate, wall time, and
+  exactness — every round must reveal the exact sum;
+- ``spans``: the span ring at exit, so ``scripts/trace_report.py`` can
+  render any banked round's flight-recorder timeline straight from this
+  artifact;
+- ``fault_counters``: injected-fault and client-retry totals (nonzero
+  only when ``SDA_FAULTS`` shapes the run);
+- ``sampler_overhead_pct``: a sampler-off vs sampler-on A/B over
+  ``--ab-rounds`` unpaced rounds each (PR-2 telemetry-A/B shape); the
+  background scrape must cost < 2%.
+
+The server runs with ``SDA_TS=0`` — the script owns the global sampler
+explicitly so the A/B legs can hold it stopped — and the live
+``GET /v1/metrics/history`` route is scraped once mid-soak to prove the
+window is served over the wire, not just in memory.
+
+Usage:
+  python scripts/load_soak.py --duration 60                 # the default soak
+  python scripts/load_soak.py --duration 20 --rate 40 --interval 1  # CI smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SDA_TS"] = "0"  # the script owns the sampler, not the server
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+DIM = 4
+MODULUS = 100003
+
+
+def build_stack(tmp: pathlib.Path, base_url: str):
+    """Recipient + committee + one pinned-rate participant, registered
+    once against the live server; rounds reuse these identities."""
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+
+    def new_client(name):
+        keystore = Keystore(str(tmp / name))
+        service = SdaHttpClient(base_url, TokenStore(str(tmp / name)))
+        return SdaClient(SdaClient.new_agent(keystore), keystore, service)
+
+    recipient = new_client("recipient")
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client(f"clerk{i}") for i in range(2)]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    participant = new_client("participant")
+    participant.upload_agent()
+    return recipient, rkey, clerks, participant
+
+
+def new_round_aggregation(recipient, rkey, clerks, tag: str):
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title=f"soak-{tag}",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(
+            modulus=MODULUS, dimension=DIM, seed_bitsize=128
+        ),
+        committee_sharing_scheme=AdditiveSharing(
+            share_count=len(clerks), modulus=MODULUS
+        ),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+    return agg
+
+
+def run_round(ix: int, stack, round_size: int, rate: float | None) -> dict:
+    """One full paced round; returns the per-round record. Raises on an
+    inexact reveal — a soak that silently aggregates wrong numbers is
+    worse than one that stops."""
+    from sda_tpu import telemetry
+
+    recipient, rkey, clerks, participant = stack
+    values = [[(ix + i) % 11, i % 7, 1, (3 * i) % 5] for i in range(round_size)]
+    expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
+
+    t_round0 = time.perf_counter()
+    with telemetry.trace(f"soak-round-{ix}") as trace_id:
+        agg = new_round_aggregation(recipient, rkey, clerks, str(ix))
+        with telemetry.span("ingest.build", rows=round_size):
+            parts = participant.new_participations(values, agg.id)
+        # pinned arrival: one submission per 1/rate seconds, absolute
+        # schedule (sleep to the slot, not after the previous request) so
+        # a slow request doesn't silently lower the offered rate
+        t0 = time.perf_counter()
+        interarrival = (1.0 / rate) if rate else 0.0
+        for i, p in enumerate(parts):
+            if interarrival:
+                delay = t0 + i * interarrival - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            with telemetry.span("ingest.upload", rows=1):
+                participant.upload_participation(p)
+        ingest_s = time.perf_counter() - t0
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+    exact = bool(np.array_equal(np.asarray(out), np.asarray(expected)))
+    if not exact:
+        raise AssertionError(
+            f"round {ix} inexact: got {list(out)}, want {expected}"
+        )
+    return {
+        "round": ix,
+        "trace_id": trace_id,
+        "n": round_size,
+        "rate_target": rate,
+        "rate_achieved": round(round_size / ingest_s, 2) if ingest_s > 0 else None,
+        "round_s": round(time.perf_counter() - t_round0, 3),
+        "exact": exact,
+    }
+
+
+def measure_sampler_overhead(stack, round_size: int, ab_rounds: int,
+                             interval_s: float) -> dict | None:
+    """Sampler-off vs sampler-on A/B (PR-2 telemetry-A/B shape): one warm
+    full round to populate the registry with every hot series (so the
+    on-arm scrapes a realistic snapshot), then ``ab_rounds`` interleaved
+    off/on batches of foreground requests — interleaving makes drift hit
+    both arms equally. The sampler runs at a deliberately hot interval
+    (10x the soak rate, floored at 50ms) so the measurement bounds the
+    production cost from above; overhead is the on-vs-off wall delta."""
+    from sda_tpu.telemetry import TimeSeriesSampler
+
+    if ab_rounds <= 0:
+        return None
+    # warm everything (JIT, connection pool, key caches) and light every
+    # series the soak will light, so the scrape under test is full-size
+    run_round(9000, stack, round_size, None)
+    service = stack[3].service
+    service.ping()
+    batch = 200
+    t_off = t_on = 0.0
+    for _ in range(ab_rounds):
+        t0 = time.perf_counter()
+        for _ in range(batch):
+            service.ping()
+        t_off += time.perf_counter() - t0
+        sampler = TimeSeriesSampler(
+            interval_s=max(0.05, interval_s / 10.0)
+        ).start()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(batch):
+                service.ping()
+            t_on += time.perf_counter() - t0
+        finally:
+            sampler.stop()
+    pct = (t_on - t_off) / t_off * 100.0
+    return {
+        "batches_per_arm": ab_rounds,
+        "requests_per_arm": ab_rounds * batch,
+        "sampler_off_s": round(t_off, 4),
+        "sampler_on_s": round(t_on, 4),
+        "overhead_pct": round(pct, 2),
+        "ok": pct < 2.0,
+    }
+
+
+def fault_counters() -> dict:
+    """Injected-fault and retry totals from the registry (labels summed
+    away) — nonzero only when SDA_FAULTS shaped the run."""
+    from sda_tpu import telemetry
+
+    out: dict = {}
+    snap = telemetry.get_registry().snapshot()
+    for (name, _labels), value in snap["counters"].items():
+        if name in ("sda_fault_injections_total", "sda_rest_retries_total"):
+            out[name] = out.get(name, 0) + value
+    return out
+
+
+def summarize(samples: list) -> dict:
+    """Headline numbers over the banked window: mean/max total rps, the
+    worst windowed p99 per hot route, and the RSS trajectory."""
+    total_rps = [
+        sum(r.get("rps", 0.0) for r in s.get("routes", {}).values())
+        for s in samples
+    ]
+    p99_by_route: dict = {}
+    for s in samples:
+        for route, r in s.get("routes", {}).items():
+            if "p99_s" in r:
+                entry = p99_by_route.setdefault(route, [])
+                entry.append(r["p99_s"])
+    rss = [s["rss_mib"] for s in samples if s.get("rss_mib")]
+    return {
+        "rps_mean": round(sum(total_rps) / len(total_rps), 2) if total_rps else None,
+        "rps_max": round(max(total_rps), 2) if total_rps else None,
+        "p99_s_by_route": {
+            route: {"max": max(v), "last": v[-1]}
+            for route, v in sorted(p99_by_route.items())
+        },
+        "rss_mib": {
+            "start": rss[0] if rss else None,
+            "end": rss[-1] if rss else None,
+            "peak": max(rss) if rss else None,
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=60.0,
+                    help="soak length in seconds (default 60)")
+    ap.add_argument("--rate", type=float, default=40.0,
+                    help="pinned arrival rate, participations/s (default 40)")
+    ap.add_argument("--round-size", type=int, default=80,
+                    help="participations per round (default 80)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="sampler interval in seconds (default 2)")
+    ap.add_argument("--ab-rounds", type=int, default=3,
+                    help="rounds per arm of the sampler overhead A/B "
+                         "(0 skips it; default 3)")
+    ap.add_argument("--artifacts", default=str(REPO / "bench-artifacts"))
+    args = ap.parse_args()
+
+    os.environ["SDA_TS_INTERVAL_S"] = str(args.interval)
+    # paged delivery so the clerk/reveal pipeline spans (the flight
+    # recorder's clerking + reveal tracks) appear in every round
+    os.environ.setdefault("SDA_JOB_PAGE_THRESHOLD", "0")
+    os.environ.setdefault("SDA_JOB_CHUNK_SIZE", "32")
+    os.environ.setdefault("SDA_RESULT_PAGE_THRESHOLD", "0")
+    os.environ.setdefault("SDA_RESULT_CHUNK_SIZE", "32")
+
+    from sda_tpu import telemetry
+    from sda_tpu.rest import serve_background
+    from sda_tpu.server import new_mem_server
+    from sda_tpu.telemetry import timeseries
+
+    if not telemetry.enabled():
+        print("load_soak: SDA_TELEMETRY=0 — nothing to sample", file=sys.stderr)
+        return 1
+
+    record: dict = {
+        "kind": "soak",
+        "config": {
+            "duration_s": args.duration,
+            "rate": args.rate,
+            "round_size": args.round_size,
+            "interval_s": args.interval,
+            "faults": os.environ.get("SDA_FAULTS"),
+        },
+    }
+    server = new_mem_server()
+    with serve_background(server) as base_url, \
+            tempfile.TemporaryDirectory() as td:
+        tmp = pathlib.Path(td)
+        stack = build_stack(tmp, base_url)
+        http = stack[3].service  # the participant's SdaHttpClient
+
+        record["sampler_ab"] = measure_sampler_overhead(
+            stack, args.round_size, args.ab_rounds, args.interval
+        )
+        if record["sampler_ab"]:
+            record["sampler_overhead_pct"] = record["sampler_ab"]["overhead_pct"]
+            print(f"[soak] sampler overhead A/B: "
+                  f"{record['sampler_overhead_pct']:+.2f}% over "
+                  f"{record['sampler_ab']['requests_per_arm']} requests/arm",
+                  file=sys.stderr)
+
+        telemetry.reset()  # the soak window starts clean of A/B traffic
+        sampler = timeseries.acquire()
+        try:
+            rounds: list = []
+            deadline = time.monotonic() + args.duration
+            ix = 0
+            while time.monotonic() < deadline:
+                rounds.append(run_round(ix, stack, args.round_size, args.rate))
+                print(f"[soak] round {ix}: {rounds[-1]['round_s']}s, "
+                      f"arrival {rounds[-1]['rate_achieved']}/s, exact",
+                      file=sys.stderr)
+                ix += 1
+            # one extra tick so work since the last interval boundary is
+            # banked, then prove the live route serves the window
+            sampler.sample_once()
+            history = http.get_metrics_history()
+            healthz = http.get_healthz()
+            ready, readyz = http.get_readyz()
+            samples = sampler.history()
+        finally:
+            timeseries.release()
+
+        record["rounds"] = rounds
+        record["samples"] = samples
+        record["summary"] = summarize(samples)
+        record["fault_counters"] = fault_counters()
+        record["history_route"] = {
+            "running": history.get("running"),
+            "samples_served": len(history.get("samples", [])),
+        }
+        record["healthz"] = healthz
+        record["readyz"] = {"ready": ready, **readyz}
+        record["spans"] = telemetry.spans()
+
+    exact = sum(1 for r in record["rounds"] if r["exact"])
+    record["exact_rounds"] = exact
+    record["total_rounds"] = len(record["rounds"])
+
+    artdir = pathlib.Path(args.artifacts)
+    artdir.mkdir(parents=True, exist_ok=True)
+    path = artdir / f"soak-{time.strftime('%Y%m%d-%H%M%S')}.json"
+    path.write_text(json.dumps(record, indent=1, default=repr))
+
+    s = record["summary"]
+    print(f"[soak] {len(record['rounds'])} rounds ({exact} exact), "
+          f"{len(record['samples'])} samples, "
+          f"rps mean {s['rps_mean']} max {s['rps_max']}, "
+          f"rss {s['rss_mib']['start']} -> {s['rss_mib']['end']} MiB "
+          f"(peak {s['rss_mib']['peak']})", file=sys.stderr)
+    print(path)
+
+    ok = (
+        record["total_rounds"] >= 1
+        and exact == record["total_rounds"]
+        and len(record["samples"]) >= 1
+        and record["history_route"]["samples_served"] >= 1
+        and record["healthz"].get("status") == "ok"
+        and record["readyz"]["ready"]
+        and (record["sampler_ab"] is None or record["sampler_ab"]["ok"])
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

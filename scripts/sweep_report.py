@@ -360,6 +360,80 @@ def print_wire(rows) -> None:
         )
 
 
+def load_soak(artdir: pathlib.Path):
+    """One row per soak-*.json artifact (scripts/load_soak.py): rounds and
+    exactness, sample count, mean/max total request rate, the worst
+    windowed p99 over the hottest route, the RSS trajectory, and the
+    sampler overhead A/B."""
+    rows = []
+    for f in sorted(artdir.glob("soak-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict) or d.get("kind") != "soak":
+            continue
+        summary = d.get("summary") if isinstance(d.get("summary"), dict) else {}
+        p99s = summary.get("p99_s_by_route") or {}
+        worst = None
+        if p99s:
+            worst_route = max(p99s, key=lambda r: p99s[r].get("max", 0))
+            worst = (worst_route, p99s[worst_route].get("max"))
+        rss = summary.get("rss_mib") or {}
+        rows.append(
+            {
+                "artifact": f.name,
+                "duration_s": (d.get("config") or {}).get("duration_s"),
+                "rate": (d.get("config") or {}).get("rate"),
+                "rounds": d.get("total_rounds"),
+                "exact": d.get("exact_rounds"),
+                "samples": len(d.get("samples") or []),
+                "rps_mean": summary.get("rps_mean"),
+                "rps_max": summary.get("rps_max"),
+                "worst_p99": worst,
+                "rss_start": rss.get("start"),
+                "rss_peak": rss.get("peak"),
+                "overhead_pct": d.get("sampler_overhead_pct"),
+                "faults": (d.get("config") or {}).get("faults"),
+            }
+        )
+    return rows
+
+
+def print_soak(rows) -> None:
+    print("\nsustained-soak riders (soak-*.json):")
+    print(
+        f"{'dur_s':>6} {'rate':>6} {'rounds':>6} {'exact':>6} {'smpls':>5} "
+        f"{'rps_mean':>8} {'rps_max':>8} {'worst_p99':>24} "
+        f"{'rss_mib':>13} {'smplr%':>7}  artifact"
+    )
+    for r in rows:
+        exact = (
+            "-" if r["exact"] is None
+            else (f"{r['exact']}/{r['rounds']}" if r["exact"] != r["rounds"]
+                  else "all")
+        )
+        worst = (
+            f"{r['worst_p99'][1]:.4f}s {r['worst_p99'][0][-16:]}"
+            if r["worst_p99"] and r["worst_p99"][1] is not None else "-"
+        )
+        rss = (
+            f"{r['rss_start']}->{r['rss_peak']}"
+            if r["rss_start"] is not None and r["rss_peak"] is not None else "-"
+        )
+        ov = f"{r['overhead_pct']:+.2f}" if r["overhead_pct"] is not None else "-"
+        tag = " +faults" if r["faults"] else ""
+        print(
+            f"{r['duration_s'] if r['duration_s'] is not None else '-':>6} "
+            f"{r['rate'] if r['rate'] is not None else '-':>6} "
+            f"{r['rounds'] if r['rounds'] is not None else '-':>6} "
+            f"{exact:>6} {r['samples']:>5} "
+            f"{r['rps_mean'] if r['rps_mean'] is not None else '-':>8} "
+            f"{r['rps_max'] if r['rps_max'] is not None else '-':>8} "
+            f"{worst:>24} {rss:>13} {ov:>7}  {r['artifact']}{tag}"
+        )
+
+
 def load_scenarios(artdir: pathlib.Path):
     """Latest record per (scenario, store, transport) cell from the churn
     harness's scenario-*.json artifacts (scripts/scenarios.py), plus any
@@ -453,6 +527,7 @@ def main() -> int:
     reveal_rows = load_reveal(artdir)
     committee_rows = load_committee(artdir)
     wire_rows = load_wire(artdir)
+    soak_rows = load_soak(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
         not rows
@@ -461,11 +536,12 @@ def main() -> int:
         and not reveal_rows
         and not committee_rows
         and not wire_rows
+        and not soak_rows
         and not scenario_cells
     ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
-            f"reveal-*.json, committee-*.json, wire-*.json, or "
+            f"reveal-*.json, committee-*.json, wire-*.json, soak-*.json, or "
             f"scenario-*.json artifacts under {artdir}/",
             file=sys.stderr,
         )
@@ -511,6 +587,8 @@ def main() -> int:
         print_committee(committee_rows)
     if wire_rows:
         print_wire(wire_rows)
+    if soak_rows:
+        print_soak(soak_rows)
     if scenario_cells:
         print_scenarios(scenario_cells, overhead_rows)
     return 0

@@ -94,7 +94,8 @@ class Clerking(VerifiedKeys):
 
         def fetch(start: int):
             t0 = time.perf_counter()
-            chunk = self.service.get_clerking_job_chunk(self.agent, job.id, start)
+            with telemetry.span("clerk.download", start=start):
+                chunk = self.service.get_clerking_job_chunk(self.agent, job.id, start)
             dt = time.perf_counter() - t0
             download_hist.observe(dt)
             stage_times["download"] += dt
@@ -149,13 +150,15 @@ class Clerking(VerifiedKeys):
         t_wall0 = time.perf_counter()
         for block in self._iter_job_chunks(job, stage_times):
             t0 = time.perf_counter()
-            with metrics.phase("clerk.decrypt"):
+            with metrics.phase("clerk.decrypt"), telemetry.span(
+                "clerk.decrypt", rows=len(block)
+            ):
                 share_vectors = decryptor.decrypt_batch(block)
             dt = time.perf_counter() - t0
             decrypt_hist.observe(dt)
             stage_times["decrypt"] += dt
             t0 = time.perf_counter()
-            with metrics.phase("clerk.combine"):
+            with metrics.phase("clerk.combine"), telemetry.span("clerk.combine"):
                 partial = combiner.combine(share_vectors)
                 combined = (
                     partial

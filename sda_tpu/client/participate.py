@@ -76,7 +76,8 @@ class Participating(VerifiedKeys):
                 telemetry.set_trace_id(trace_id)
             t0 = time.perf_counter()
             try:
-                self.upload_participations(batch)
+                with telemetry.span("ingest.upload", rows=len(batch)):
+                    self.upload_participations(batch)
             except BaseException as e:
                 errors.append(e)
             finally:
@@ -85,9 +86,10 @@ class Participating(VerifiedKeys):
         inflight = None
         for lo in range(0, len(values_list), chunk_size):
             t0 = time.perf_counter()
-            batch = self.new_participations(
-                values_list[lo : lo + chunk_size], aggregation_id
-            )
+            with telemetry.span("ingest.build", rows=min(chunk_size, len(values_list) - lo)):
+                batch = self.new_participations(
+                    values_list[lo : lo + chunk_size], aggregation_id
+                )
             build_hist.observe(time.perf_counter() - t0)
             built_total.inc(len(batch))
             if inflight is not None:

@@ -88,7 +88,8 @@ def _iter_result_chunks(fetch, total: int, what: str, stage_times: dict):
 
     def timed_fetch(start: int):
         t0 = time.perf_counter()
-        chunk = fetch(start)
+        with telemetry.span("reveal.download", what=what, start=start):
+            chunk = fetch(start)
         dt = time.perf_counter() - t0
         download_hist.observe(dt)
         stage_times["download"] += dt
@@ -241,12 +242,14 @@ class Receiving:
             ).accumulator()
             for block in mask_chunks:
                 t0 = time.perf_counter()
-                decrypted = decryptor.decrypt_batch(block)
+                with telemetry.span("reveal.decrypt", what="masks", rows=len(block)):
+                    decrypted = decryptor.decrypt_batch(block)
                 dt = time.perf_counter() - t0
                 decrypt_hist.observe(dt)
                 stage_times["decrypt"] += dt
                 t0 = time.perf_counter()
-                accumulator.fold(decrypted)
+                with telemetry.span("reveal.fold"):
+                    accumulator.fold(decrypted)
                 dt = time.perf_counter() - t0
                 fold_hist.observe(dt)
                 stage_times["fold"] += dt
@@ -265,9 +268,10 @@ class Receiving:
                 if clerking_result.clerk not in clerk_positions:
                     raise ValueError(f"Missing clerk {clerking_result.clerk}")
             t0 = time.perf_counter()
-            share_vectors = decryptor.decrypt_batch(
-                [cr.encryption for cr in block]
-            )
+            with telemetry.span("reveal.decrypt", what="clerks", rows=len(block)):
+                share_vectors = decryptor.decrypt_batch(
+                    [cr.encryption for cr in block]
+                )
             dt = time.perf_counter() - t0
             decrypt_hist.observe(dt)
             stage_times["decrypt"] += dt
@@ -299,13 +303,14 @@ class Receiving:
             )
 
         t0 = time.perf_counter()
-        reconstructor = self.crypto.new_secret_reconstructor(
-            aggregation.committee_sharing_scheme, aggregation.vector_dimension
-        )
-        masked_output = reconstructor.reconstruct(indexed_shares)
+        with telemetry.span("reveal.reconstruct", shares=len(indexed_shares)):
+            reconstructor = self.crypto.new_secret_reconstructor(
+                aggregation.committee_sharing_scheme, aggregation.vector_dimension
+            )
+            masked_output = reconstructor.reconstruct(indexed_shares)
 
-        unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
-        output = unmasker.unmask(mask, masked_output)
+            unmasker = self.crypto.new_secret_unmasker(aggregation.masking_scheme)
+            output = unmasker.unmask(mask, masked_output)
         dt = time.perf_counter() - t0
         telemetry.histogram(_STAGE_SERIES, _STAGE_HELP, stage="reconstruct").observe(dt)
         stage_times["reconstruct"] += dt

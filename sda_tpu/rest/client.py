@@ -244,6 +244,30 @@ class SdaHttpClient(SdaService):
     def ping(self) -> Pong:
         return Pong.from_json(self._request("GET", "/v1/ping"))
 
+    # -- observability (additive, unauthenticated) ---------------------------
+
+    def get_metrics_history(self, n: int | None = None) -> dict:
+        """The server's time-series window (``GET /v1/metrics/history``):
+        ``{running, interval_s, samples: [...]}``, newest-last."""
+        params = {"n": int(n)} if n else None
+        return self._request("GET", "/v1/metrics/history", params=params)
+
+    def get_healthz(self) -> dict:
+        return self._request("GET", "/v1/healthz")
+
+    def get_readyz(self) -> tuple:
+        """Readiness probe: ``(ready, body)`` — unlike the protocol calls
+        a 503 here is an *answer* (drain me), not an error, so this reads
+        the raw response instead of the retrying error-mapped path."""
+        resp = self.session.get(
+            self.server_root + "/v1/readyz", timeout=self.timeout
+        )
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {"status": "unready", "error": resp.text}
+        return resp.status_code == 200, body
+
     # -- agents -------------------------------------------------------------
 
     # The POSTs below opt into retries (idempotent=True): every matching

@@ -31,6 +31,9 @@ the reference's rouille binding (/root/reference/server-http/src/lib.rs):
     GET    /v1/aggregations/{AggregationId}/snapshots/{SnapshotId}/result/clerks/{start}
     GET    /v1/metrics        (additive; unauthenticated Prometheus text)
     GET    /v1/metrics.json   (additive; unauthenticated telemetry snapshot)
+    GET    /v1/metrics/history (additive; time-series sampler window)
+    GET    /v1/healthz        (additive; liveness — process is serving)
+    GET    /v1/readyz         (additive; readiness — store reachable, else 503)
 
 Wire negotiation (docs/protocol.md): the hot bulk routes — the
 participation batch POST and the three chunk GETs — speak
@@ -86,6 +89,7 @@ from http import HTTPStatus
 from urllib.parse import unquote_plus
 
 from .. import telemetry
+from ..telemetry import timeseries
 from ..utils import faults
 from . import wire
 from ..protocol import (
@@ -124,6 +128,16 @@ def _idle_timeout_s() -> float:
     vanish; ``shutdown()`` does not wait for it — live connections are
     force-closed at teardown."""
     return max(0.05, float(os.environ.get("SDA_REST_IDLE_TIMEOUT_S", "60")))
+
+
+def _slow_request_s() -> float:
+    """Latency above which a request earns a warning log line and an
+    ``sda_slow_requests_total`` tick (``SDA_SLOW_REQUEST_S``, default 1s;
+    0 disables)."""
+    try:
+        return max(0.0, float(os.environ.get("SDA_SLOW_REQUEST_S", "1.0")))
+    except ValueError:
+        return 1.0
 
 
 def _worker_count() -> int:
@@ -341,8 +355,24 @@ class _RequestContext:
                         "status": self.status,
                         "request_id": self.request_id,
                     }
+            # slow-request visibility is independent of the metrics plane:
+            # the warning line fires even with telemetry disabled
+            elapsed = time.perf_counter() - t0
+            slow_after = _slow_request_s()
+            if slow_after and elapsed >= slow_after:
+                log.warning(
+                    "slow request: %s %s took %.3fs (threshold %.3gs, "
+                    "status %s, request %s, trace %s)",
+                    self.method, self.path, elapsed, slow_after,
+                    self.status, self.request_id, self.trace_id,
+                )
+                if telemetry.enabled():
+                    telemetry.counter(
+                        "sda_slow_requests_total",
+                        "requests slower than SDA_SLOW_REQUEST_S by route template",
+                        route=route,
+                    ).inc()
             if telemetry.enabled():
-                elapsed = time.perf_counter() - t0
                 telemetry.histogram(
                     "sda_http_request_seconds",
                     "REST request latency by route template",
@@ -443,6 +473,46 @@ class _RequestContext:
                 telemetry.snapshot(), separators=(",", ":"), default=repr
             ).encode("utf-8")
             self._send(200, body)
+            return True
+
+        if method == "GET" and path == "/v1/metrics/history":
+            # the time-series sampler's in-memory window (docs/api.md):
+            # unauthenticated like /v1/metrics — windowed rates/quantiles
+            # only, no resource data. ?n= caps the returned samples.
+            n = None
+            raw_n = params.get("n")
+            if raw_n:
+                try:
+                    n = int(raw_n)
+                except ValueError:
+                    raise InvalidRequestError("n must be a positive integer")
+                if n <= 0:
+                    raise InvalidRequestError("n must be a positive integer")
+            body = json.dumps(
+                timeseries.history(n), separators=(",", ":")
+            ).encode("utf-8")
+            self._send(200, body)
+            return True
+
+        if method == "GET" and path == "/v1/healthz":
+            # liveness: the process is up and serving requests
+            self._send(200, b'{"status":"ok"}')
+            return True
+
+        if method == "GET" and path == "/v1/readyz":
+            # readiness: the service can actually reach its store; a
+            # wedged backend answers 503 so a balancer drains this node
+            try:
+                svc.ping()
+                self._send(200, b'{"status":"ready"}')
+            except Exception as e:
+                self._send(
+                    503,
+                    json.dumps(
+                        {"status": "unready", "error": str(e)},
+                        separators=(",", ":"),
+                    ).encode("utf-8"),
+                )
             return True
 
         if method == "POST" and path == "/v1/agents/me":
@@ -686,12 +756,19 @@ class SdaRestServer:
         self._executor = ThreadPoolExecutor(
             max_workers=_worker_count(), thread_name_prefix="sda-rest"
         )
+        # the time-series sampler rides the server lifecycle (refcounted:
+        # N in-process servers share one thread); SDA_TS=0 opts out
+        sampler_held = os.environ.get("SDA_TS", "1") != "0"
+        if sampler_held:
+            timeseries.acquire()
         try:
             asyncio.run(self._main())
         finally:
             self._started.set()  # unblock shutdown() even on startup failure
             self._stopped.set()
             self._executor.shutdown(wait=False)
+            if sampler_held:
+                timeseries.release()
 
     async def _main(self):
         self._loop = asyncio.get_running_loop()

@@ -35,6 +35,7 @@ from .spans import (
     set_trace_id,
     trace,
 )
+from .timeseries import TimeSeriesSampler, histogram_quantile, read_rss_mib
 
 _REGISTRY = Registry()
 _SPANS = SpanLog(_REGISTRY)
@@ -115,6 +116,9 @@ __all__ = [
     "Histogram",
     "Registry",
     "SpanLog",
+    "TimeSeriesSampler",
+    "histogram_quantile",
+    "read_rss_mib",
     "DEFAULT_BUCKETS",
     "PROMETHEUS_CONTENT_TYPE",
     "TRACE_HEADER",

@@ -1,0 +1,103 @@
+"""scripts/bench_compare.py — the newest-vs-previous throughput gate.
+
+The gate's job is to fail CI on a real cliff and stay quiet otherwise,
+so both directions are pinned: a >threshold drop exits 1, noise inside
+the threshold (and improvements) exit 0, and families with fewer than
+two artifacts never fail the run.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_compare",
+    pathlib.Path(__file__).resolve().parent.parent / "scripts" / "bench_compare.py",
+)
+bench_compare = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_compare)
+
+
+def _write(d, name, obj):
+    (d / name).write_text(json.dumps(obj))
+
+
+def _run(tmp_path, *argv):
+    old = sys.argv
+    sys.argv = ["bench_compare.py", str(tmp_path), *argv]
+    try:
+        return bench_compare.main()
+    finally:
+        sys.argv = old
+
+
+def test_regression_beyond_threshold_fails(tmp_path, capsys):
+    _write(tmp_path, "wire-20260801-010000.json",
+           {"binary": {"ingest_per_s": 50000}, "json": {"ingest_per_s": 17000}})
+    _write(tmp_path, "wire-20260805-010000.json",
+           {"binary": {"ingest_per_s": 30000},   # -40%: regressed
+            "json": {"ingest_per_s": 16500}})    # -2.9%: noise
+    assert _run(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    assert out.count("REGRESSED") == 1  # only the binary leg trips
+
+
+def test_improvement_and_noise_pass(tmp_path, capsys):
+    _write(tmp_path, "soak-20260801-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 50.0}})
+    _write(tmp_path, "soak-20260805-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 48.0}})  # -4%: inside
+    _write(tmp_path, "ingest-20260801-010000.json", {"build_per_s": 800})
+    _write(tmp_path, "ingest-20260805-010000.json", {"build_per_s": 900})
+    assert _run(tmp_path) == 0
+    out = capsys.readouterr().out
+    assert "no throughput regressions" in out
+    assert "soak: soak-20260801-010000.json -> soak-20260805-010000.json" in out
+
+
+def test_threshold_is_tunable(tmp_path):
+    _write(tmp_path, "soak-20260801-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 100.0}})
+    _write(tmp_path, "soak-20260805-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 90.0}})  # -10%
+    assert _run(tmp_path) == 0                    # default 15%: passes
+    assert _run(tmp_path, "--threshold", "5") == 1  # tightened: fails
+
+
+def test_newest_two_of_three_are_compared(tmp_path, capsys):
+    """The gate pins newest-vs-previous, not newest-vs-best: an old fast
+    run must not haunt every later comparison."""
+    _write(tmp_path, "ingest-20260701-010000.json", {"build_per_s": 9000})
+    _write(tmp_path, "ingest-20260801-010000.json", {"build_per_s": 500})
+    _write(tmp_path, "ingest-20260805-010000.json", {"build_per_s": 510})
+    assert _run(tmp_path) == 0
+    out = capsys.readouterr().out
+    assert "ingest-20260801-010000.json -> ingest-20260805-010000.json" in out
+
+
+def test_committee_compares_best_per_plane(tmp_path):
+    _write(tmp_path, "committee-20260801-010000.json",
+           {"planes": {"clerking": {"w1": {"per_s": 9000},
+                                    "w4": {"per_s": 27000}}},
+            "read_pool": {"t4": {"reads_per_s": 76.0}}})
+    _write(tmp_path, "committee-20260805-010000.json",
+           {"planes": {"clerking": {"w1": {"per_s": 9100},
+                                    "w4": {"per_s": 12000}}},  # envelope -55%
+            "read_pool": {"t4": {"reads_per_s": 75.0}}})
+    assert _run(tmp_path) == 1
+
+
+def test_single_artifact_and_garbage_are_na(tmp_path, capsys):
+    _write(tmp_path, "wire-20260805-010000.json",
+           {"binary": {"ingest_per_s": 50000}})
+    (tmp_path / "soak-20260805-010000.json").write_text("{not json")
+    _write(tmp_path, "soak-20260805-020000.json", {"note": "no summary"})
+    assert _run(tmp_path) == 0
+    out = capsys.readouterr().out
+    assert "wire: n/a" in out and "soak: n/a" in out
+
+
+def test_empty_dir_is_not_a_regression(tmp_path):
+    assert _run(tmp_path) == 0

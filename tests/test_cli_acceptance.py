@@ -44,9 +44,13 @@ def _cpu_bench_env():
     env.pop("SDA_BENCH_DEADLINE", None)
     env.pop("SDA_BENCH_PROBE_BUDGET_S", None)
     env.pop("SDA_FAULTS", None)
-    # test subprocesses must not litter bench-artifacts/ with ingest
-    # rider artifacts (stdout metric lines still exercise the rider)
+    # test subprocesses must not litter bench-artifacts/
     env["SDA_BENCH_ARTIFACTS"] = "0"
+    # the protocol-plane riders drive full REST rounds (~30s per child on
+    # one core) and nothing here reads their output — every assertion in
+    # this file is about the device metric line and the probe/error
+    # contracts, so the ~17 bench children skip the riders
+    env["SDA_BENCH_RIDERS"] = "0"
     return repo, env
 
 

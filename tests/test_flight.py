@@ -1,0 +1,137 @@
+"""Round flight recorder: Chrome trace export validity/determinism and
+the round_report waterfall/overlap/critical-path math on hand-built spans."""
+
+import json
+
+import pytest
+
+from sda_tpu.telemetry import flight
+
+
+def _span(name, start, dur, trace_id="t1", **attrs):
+    return {
+        "name": name,
+        "trace_id": trace_id,
+        "start": start,
+        "duration_s": dur,
+        "attrs": attrs or None,
+    }
+
+
+# A hand-built pipelined round (seconds, offsets from 100.0):
+#   ingest.upload   [0.0, 1.0)
+#   clerk.download  [0.5, 1.5)   -- overlaps the upload tail
+#   clerk.decrypt   [1.5, 2.0)
+#   reveal.fold     [2.5, 3.0)   -- after a 0.5s gap
+ROUND = [
+    _span("ingest.upload", 100.0, 1.0, rows=8),
+    _span("clerk.download", 100.5, 1.0),
+    _span("clerk.decrypt", 101.5, 0.5),
+    _span("reveal.fold", 102.5, 0.5),
+]
+
+
+# -- chrome trace export -----------------------------------------------------
+
+
+def test_chrome_trace_is_valid_and_deterministic():
+    doc = flight.chrome_trace(ROUND)
+    assert doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    # metadata rows name the process and each used track
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {"process_name", "thread_name", "thread_sort_index"} <= {
+        e["name"] for e in meta
+    }
+    named_tracks = {
+        e["args"]["name"] for e in meta if e["name"] == "thread_name"
+    }
+    assert named_tracks == {"ingest", "clerk", "reveal"}
+    # one X event per span, µs timestamps relative to the earliest start
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == len(ROUND)
+    by_name = {e["name"]: e for e in xs}
+    assert by_name["ingest.upload"]["ts"] == 0.0
+    assert by_name["ingest.upload"]["dur"] == pytest.approx(1e6)
+    assert by_name["reveal.fold"]["ts"] == pytest.approx(2.5e6)
+    assert by_name["clerk.download"]["cat"] == "clerk"
+    assert by_name["ingest.upload"]["args"]["rows"] == 8
+    assert by_name["ingest.upload"]["args"]["trace_id"] == "t1"
+    # distinct tracks per stage
+    assert by_name["ingest.upload"]["tid"] != by_name["clerk.decrypt"]["tid"]
+
+    # byte-identical across calls and round-trippable (Perfetto-loadable)
+    j1 = flight.chrome_trace_json(ROUND)
+    j2 = flight.chrome_trace_json(list(reversed(ROUND)))  # order-insensitive
+    assert j1 == j2
+    assert json.loads(j1) == doc
+
+
+def test_chrome_trace_skips_unfinished_spans():
+    spans = ROUND + [_span("clerk.download", 103.0, None)]
+    xs = [e for e in flight.chrome_trace(spans)["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == len(ROUND)
+
+
+def test_chrome_trace_empty():
+    doc = flight.chrome_trace([])
+    assert [e["ph"] for e in doc["traceEvents"]] == ["M"]  # process_name only
+
+
+# -- round_report ------------------------------------------------------------
+
+
+def test_round_report_numbers():
+    r = flight.round_report(ROUND)
+    assert r["spans"] == 4
+    assert r["wall_s"] == pytest.approx(3.0)
+    # union coverage: [0,2.0) plus [2.5,3.0) -> 2.5s busy, 0.5s gap
+    assert r["busy_s"] == pytest.approx(2.5)
+    assert r["span_s"] == pytest.approx(3.0)
+    assert r["overlap_efficiency"] == pytest.approx((3.0 - 2.5) / 3.0, abs=1e-4)
+
+    rows = {row["stage"]: row for row in r["stages"]}
+    assert list(rows) == ["ingest", "clerk", "reveal"]  # ordered by first start
+    assert rows["ingest"]["offset_s"] == 0.0
+    assert rows["clerk"]["offset_s"] == pytest.approx(0.5)
+    assert rows["clerk"]["busy_s"] == pytest.approx(1.5)
+    assert rows["clerk"]["spans"] == 2
+    assert rows["reveal"]["share"] == pytest.approx(0.5 / 3.0, abs=1e-3)
+
+    # critical path: upload holds the clock first, the download reaches
+    # past its end, then decrypt, gap-jump, then fold
+    names = [h["name"] for h in r["critical_path"]]
+    assert names == ["ingest.upload", "clerk.download", "clerk.decrypt",
+                     "reveal.fold"]
+    assert r["critical_path"][0]["offset_s"] == 0.0
+    assert r["critical_path"][1]["offset_s"] == pytest.approx(0.5)
+
+
+def test_round_report_fully_sequential_and_empty():
+    seq = [_span("a.x", 0.0, 1.0), _span("b.y", 1.0, 1.0)]
+    r = flight.round_report(seq)
+    assert r["overlap_efficiency"] == 0.0
+    assert [h["name"] for h in r["critical_path"]] == ["a.x", "b.y"]
+
+    empty = flight.round_report([])
+    assert empty["spans"] == 0 and empty["stages"] == []
+    assert empty["critical_path"] == []
+
+
+def test_critical_path_containment():
+    # a short span fully inside a long one never appears on the path
+    spans = [_span("svc.outer", 0.0, 5.0), _span("svc.inner", 1.0, 1.0)]
+    assert [s["name"] for s in flight.critical_path(spans)] == ["svc.outer"]
+
+
+def test_traces_in_groups_and_orders():
+    spans = (
+        [_span("a.x", 10.0, 1.0, trace_id="r1")]
+        + [_span("b.y", 11.0, 2.0, trace_id="r2")]
+        + [_span("a.z", 10.5, 1.0, trace_id="r1")]
+        + [_span("c.w", 12.0, 1.0, trace_id=None)]  # untraced: dropped
+    )
+    out = flight.traces_in(spans)
+    assert [t["trace_id"] for t in out] == ["r1", "r2"]
+    assert out[0]["spans"] == 2
+    assert out[0]["wall_s"] == pytest.approx(1.5)

@@ -336,3 +336,117 @@ def test_idle_keepalive_connections_are_reaped(tmp_path, monkeypatch):
                     break
                 rest += chunk
             assert time.perf_counter() - t0 < 5.0
+
+
+# -- observability plane: trace adoption, health, history, slow requests ----
+
+
+def _recv_response(sock, buf: bytes):
+    """Read one full HTTP/1.1 response off a keep-alive socket; returns
+    (head_bytes, leftover_buf) with the body consumed per Content-Length."""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        assert chunk, "server closed mid-response"
+        buf += chunk
+    head, _, buf = buf.partition(b"\r\n\r\n")
+    clen = 0
+    for line in head.decode("latin-1").split("\r\n")[1:]:
+        name, _, value = line.partition(":")
+        if name.strip().lower() == "content-length":
+            clen = int(value.strip())
+    while len(buf) < clen:
+        chunk = sock.recv(4096)
+        assert chunk, "server closed mid-body"
+        buf += chunk
+    return head, buf[clen:]
+
+
+def test_trace_id_adopted_per_request_under_keepalive(http_ctx):
+    """Two rounds down ONE persistent connection, each with its own
+    X-SDA-Trace header: the server must adopt and echo the right id per
+    request — a leak of request 1's id into request 2's spans means
+    adoption/reset is per-connection instead of per-dispatch."""
+    import socket
+    from urllib.parse import urlparse
+
+    from sda_tpu import telemetry
+
+    _, base_url, tmp_path = http_ctx
+    parsed = urlparse(base_url)
+    telemetry.reset()
+    ids = ("trace-keepalive-one", "trace-keepalive-two")
+    with socket.create_connection((parsed.hostname, parsed.port), timeout=10) as s:
+        s.settimeout(10)
+        buf = b""
+        for tid in ids:
+            s.sendall(
+                b"GET /v1/ping HTTP/1.1\r\n"
+                + f"Host: {parsed.hostname}\r\n".encode()
+                + f"{telemetry.TRACE_HEADER}: {tid}\r\n\r\n".encode()
+            )
+            head, buf = _recv_response(s, buf)
+            assert head.startswith(b"HTTP/1.1 200")
+            headers = head.decode("latin-1").lower()
+            # same socket — yet each response echoes its own trace id
+            assert f"{telemetry.TRACE_HEADER.lower()}: {tid}" in headers, headers
+    # and the server-side spans carry the per-request ids, not a shared one
+    for tid in ids:
+        assert telemetry.spans(name="http.request", trace_id=tid), tid
+
+
+def test_health_and_readiness_routes(http_ctx):
+    """/v1/healthz answers unconditionally; /v1/readyz proves the service
+    behind the router responds to ping. Both unauthenticated."""
+    _, base_url, tmp_path = http_ctx
+    r = requests.get(f"{base_url}/v1/healthz")
+    assert r.status_code == 200 and r.json() == {"status": "ok"}
+    r = requests.get(f"{base_url}/v1/readyz")
+    assert r.status_code == 200 and r.json()["status"] == "ready"
+    # the client helpers speak the same routes
+    client = SdaHttpClient(base_url, TokenStore(tmp_path))
+    assert client.get_healthz()["status"] == "ok"
+    ready, body = client.get_readyz()
+    assert ready and body["status"] == "ready"
+
+
+def test_metrics_history_route(http_ctx):
+    """/v1/metrics/history serves the sampler window (shape is stable even
+    before the first tick lands); ?n= must be a positive integer."""
+    _, base_url, tmp_path = http_ctx
+    r = requests.get(f"{base_url}/v1/metrics/history")
+    assert r.status_code == 200
+    body = r.json()
+    assert {"running", "interval_s", "samples"} <= set(body)
+    assert isinstance(body["samples"], list)
+    for bad in ("zzz", "-1", "0"):
+        r = requests.get(f"{base_url}/v1/metrics/history?n={bad}")
+        assert r.status_code == 400, bad
+    # client helper round-trips the same shape
+    client = SdaHttpClient(base_url, TokenStore(tmp_path))
+    assert isinstance(client.get_metrics_history(n=5)["samples"], list)
+
+
+def test_slow_request_threshold(http_ctx, monkeypatch, caplog):
+    """With SDA_SLOW_REQUEST_S below any real latency every request trips
+    the slow-request warning + counter; 0 disables the check entirely."""
+    import logging
+
+    from sda_tpu import telemetry
+
+    _, base_url, tmp_path = http_ctx
+    monkeypatch.setenv("SDA_SLOW_REQUEST_S", "0.000001")
+    with caplog.at_level(logging.WARNING, logger="sda.rest.server"):
+        assert requests.get(f"{base_url}/v1/ping").status_code == 200
+    assert any("slow request" in rec.message for rec in caplog.records)
+    snap = telemetry.get_registry().snapshot()
+    slow = [
+        v for (name, labels), v in snap["counters"].items()
+        if name == "sda_slow_requests_total"
+    ]
+    assert sum(slow) >= 1
+    # threshold 0 switches the check off
+    monkeypatch.setenv("SDA_SLOW_REQUEST_S", "0")
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="sda.rest.server"):
+        requests.get(f"{base_url}/v1/ping")
+    assert not any("slow request" in rec.message for rec in caplog.records)

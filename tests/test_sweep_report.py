@@ -222,6 +222,38 @@ def test_wire_rider_section(tmp_path, capsys):
     assert "wire-broken.json" not in out
 
 
+def test_soak_rider_section(tmp_path, capsys):
+    _write(tmp_path, "soak-20260806-010000.json",
+           {"kind": "soak",
+            "config": {"duration_s": 60.0, "rate": 40.0, "round_size": 80},
+            "total_rounds": 12, "exact_rounds": 12,
+            "samples": [{"t": 1.0}, {"t": 2.0}, {"t": 3.0}],
+            "sampler_overhead_pct": 0.84,
+            "summary": {"rps_mean": 55.7, "rps_max": 65.6,
+                        "p99_s_by_route": {
+                            "aggregations/participations":
+                                {"max": 0.021, "last": 0.012},
+                            "ping": {"max": 0.002, "last": 0.001}},
+                        "rss_mib": {"start": 45.0, "end": 46.5,
+                                    "peak": 47.1}}})
+    _write(tmp_path, "soak-broken.json", {"note": "not a soak record"})
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # soak rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "sustained-soak riders" in out
+    assert "soak-20260806-010000.json" in out
+    assert "all" in out          # every round exact collapses to "all"
+    assert "0.0210s" in out      # worst p99 belongs to the hottest route
+    assert "45.0->47.1" in out   # RSS start->peak trajectory
+    assert "+0.84" in out        # sampler overhead column
+    assert "soak-broken.json" not in out
+
+
 def test_scenario_survivability_section(tmp_path, capsys):
     _write(tmp_path, "scenario-vanish-after-sharing-20260805-050000-mem-rest.json",
            {"scenario": "vanish-after-sharing", "store": "mem",

@@ -1,0 +1,212 @@
+"""Time-series sampler: windowed quantile math, scrape-and-difference
+deltas, the JSONL ring bound, and the refcounted process-wide lifecycle."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sda_tpu.telemetry import DEFAULT_BUCKETS, Registry
+from sda_tpu.telemetry.timeseries import (
+    TimeSeriesSampler,
+    _delta_counts,
+    histogram_quantile,
+    read_rss_mib,
+)
+
+
+def _bucketize(values, buckets=DEFAULT_BUCKETS):
+    """Counts in the registry's layout: value v lands in the first bucket
+    whose edge >= v; one trailing +Inf bucket."""
+    import bisect
+
+    counts = [0] * (len(buckets) + 1)
+    for v in values:
+        counts[bisect.bisect_left(buckets, v)] += 1
+    return counts
+
+
+# -- histogram_quantile ------------------------------------------------------
+
+
+@pytest.mark.parametrize("q", [0.5, 0.95, 0.99])
+def test_quantile_tracks_exact_percentile_within_bucket_width(q):
+    """The interpolated quantile must land inside the same bucket as the
+    exact percentile — the error bound of a bucketed sketch."""
+    rng = np.random.default_rng(7)
+    values = rng.lognormal(mean=-4.0, sigma=1.0, size=5000)
+    counts = _bucketize(values)
+    approx = histogram_quantile(q, DEFAULT_BUCKETS, counts)
+    exact = float(np.percentile(values, q * 100))
+    # containing bucket of the exact percentile -> its width is the bound
+    import bisect
+
+    i = bisect.bisect_left(DEFAULT_BUCKETS, exact)
+    lo = 0.0 if i == 0 else DEFAULT_BUCKETS[i - 1]
+    hi = DEFAULT_BUCKETS[min(i, len(DEFAULT_BUCKETS) - 1)]
+    assert abs(approx - exact) <= (hi - lo) + 1e-12, (approx, exact, lo, hi)
+
+
+def test_quantile_edge_cases():
+    buckets = (0.1, 1.0, 10.0)
+    # empty window
+    assert histogram_quantile(0.99, buckets, [0, 0, 0, 0]) is None
+    # all mass in one bucket: interpolates within (0.1, 1.0]
+    v = histogram_quantile(0.5, buckets, [0, 10, 0, 0])
+    assert 0.1 < v <= 1.0
+    # +Inf bucket clamps to the top finite edge
+    assert histogram_quantile(0.99, buckets, [0, 0, 0, 5]) == 10.0
+    # q is clamped into [0, 1]
+    assert histogram_quantile(2.0, buckets, [1, 0, 0, 0]) <= 0.1
+
+
+def test_delta_counts_clamps_resets():
+    assert _delta_counts([5, 3], [2, 1]) == [3, 2]
+    # registry reset mid-window: clamped to zero, never negative
+    assert _delta_counts([1, 0], [9, 4]) == [0, 0]
+    # no previous scrape: the new counts stand
+    assert _delta_counts([4, 2], None) == [4, 2]
+
+
+# -- window deltas over a live registry --------------------------------------
+
+
+def test_sample_once_windows_are_deltas_not_cumulative():
+    reg = Registry(enabled=True)
+    hist = reg.histogram("sda_http_request_seconds", route="ping", status="200")
+    ctr = reg.counter("sda_http_requests_total", route="ping", status="200")
+    sampler = TimeSeriesSampler(registry=reg, interval_s=60, window=8)
+
+    for _ in range(10):
+        ctr.inc()
+        hist.observe(0.005)
+    t0 = sampler._prev_t
+    s1 = sampler.sample_once(now=t0 + 2.0)
+    assert s1["routes"]["ping"]["rps"] == pytest.approx(5.0)
+    assert 0.001 < s1["routes"]["ping"]["p99_s"] <= 0.01
+
+    # second window: only the NEW observations count
+    for _ in range(4):
+        ctr.inc()
+        hist.observe(1.5)
+    s2 = sampler.sample_once(now=t0 + 4.0)
+    assert s2["routes"]["ping"]["rps"] == pytest.approx(2.0)
+    assert s2["routes"]["ping"]["p99_s"] > 1.0  # window holds only slow obs
+
+    # an idle window reports no route activity at all
+    s3 = sampler.sample_once(now=t0 + 6.0)
+    assert s3["routes"] == {}
+
+    # every tick banked in memory and counted in the registry
+    assert [s["t"] for s in sampler.history()] == [s1["t"], s2["t"], s3["t"]]
+    snap = reg.snapshot()
+    totals = [
+        v for (name, _), v in snap["counters"].items()
+        if name == "sda_ts_samples_total"
+    ]
+    assert sum(totals) == 3
+
+
+def test_sampler_baseline_excludes_preexisting_history():
+    """A sampler attached to a warm registry must not report the whole
+    process history as its first window."""
+    reg = Registry(enabled=True)
+    ctr = reg.counter("sda_http_requests_total", route="ping", status="200")
+    ctr.inc(1000)
+    sampler = TimeSeriesSampler(registry=reg, interval_s=60, window=4)
+    ctr.inc(3)
+    s = sampler.sample_once(now=sampler._prev_t + 1.0)
+    assert s["routes"]["ping"]["rps"] == pytest.approx(3.0)
+
+
+def test_sample_shape_and_rate_counters():
+    reg = Registry(enabled=True)
+    reg.counter("sda_wire_bytes_total", direction="in").inc(4096)
+    reg.counter("sda_wire_bytes_total", direction="out").inc(1024)
+    reg.counter("sda_fault_injections_total", kind="drop").inc(2)
+    reg.histogram("sda_store_op_seconds", store="agents", op="read").observe(0.002)
+    sampler = TimeSeriesSampler(registry=reg, interval_s=60, window=4)
+    reg.counter("sda_wire_bytes_total", direction="in").inc(2000)
+    reg.counter("sda_fault_injections_total", kind="drop").inc(1)
+    reg.histogram("sda_store_op_seconds", store="agents", op="read").observe(0.004)
+    s = sampler.sample_once(now=sampler._prev_t + 2.0)
+    assert s["wire_bytes_per_s"]["in"] == pytest.approx(1000.0)
+    assert s["wire_bytes_per_s"]["out"] == 0.0
+    assert s["rates"]["sda_fault_injections_total"] == pytest.approx(0.5)
+    assert s["store_ops"]["agents.read"]["ops_s"] == pytest.approx(0.5)
+    assert s["store_ops"]["agents.read"]["p99_s"] > 0
+    assert s["rss_mib"] > 0
+    assert {"t", "dt_s", "rss_mib", "routes", "store_ops",
+            "wire_bytes_per_s", "rates"} <= set(s)
+    # the sample is JSON-clean as banked (ring + REST route both dump it)
+    assert json.loads(json.dumps(s)) == s
+
+
+def test_in_memory_window_is_bounded():
+    reg = Registry(enabled=True)
+    sampler = TimeSeriesSampler(registry=reg, interval_s=60, window=3)
+    for i in range(10):
+        sampler.sample_once(now=sampler._prev_t + 1.0)
+    assert len(sampler.history()) == 3
+    assert len(sampler.history(n=2)) == 2
+
+
+# -- on-disk JSONL ring ------------------------------------------------------
+
+
+def test_jsonl_ring_stays_bounded_and_keeps_newest(tmp_path):
+    path = tmp_path / "ts.jsonl"
+    reg = Registry(enabled=True)
+    sampler = TimeSeriesSampler(
+        registry=reg, interval_s=60, window=4,
+        path=str(path), max_bytes=4096,
+    )
+    for _ in range(200):
+        sampler.sample_once(now=sampler._prev_t + 1.0)
+    size = path.stat().st_size
+    assert size <= 4096 + 512  # bound plus at most a few trailing lines
+    lines = path.read_text().splitlines()
+    assert lines, "ring should retain the newest lines"
+    # every surviving line is intact JSON (truncation is line-atomic) and
+    # the final line is the newest sample
+    parsed = [json.loads(ln) for ln in lines]
+    assert parsed[-1]["t"] == sampler.history()[-1]["t"]
+    assert [p["t"] for p in parsed] == sorted(p["t"] for p in parsed)
+
+
+def test_jsonl_ring_survives_unwritable_path(tmp_path):
+    reg = Registry(enabled=True)
+    sampler = TimeSeriesSampler(
+        registry=reg, interval_s=60, window=4,
+        path=str(tmp_path / "no" / "such" / "dir" / "ts.jsonl"),
+    )
+    s = sampler.sample_once(now=sampler._prev_t + 1.0)  # must not raise
+    assert s["dt_s"] == pytest.approx(1.0)
+
+
+# -- process-wide refcounted lifecycle ---------------------------------------
+
+
+def test_global_acquire_release_refcounting(monkeypatch):
+    from sda_tpu.telemetry import timeseries
+
+    monkeypatch.setenv("SDA_TS_INTERVAL_S", "30")
+    refs0 = timeseries._global_refs
+    a = timeseries.acquire()
+    b = timeseries.acquire()
+    assert a is b and timeseries.get() is a
+    assert a._thread is not None and a._thread.is_alive()
+    timeseries.release()
+    assert timeseries.get() is a  # still held by the other ref
+    timeseries.release()
+    assert timeseries._global_refs == refs0
+    if refs0 == 0:
+        assert timeseries.get() is None
+        # history() has a stable empty shape with no sampler
+        assert timeseries.history() == {
+            "running": False, "interval_s": None, "samples": [],
+        }
+
+
+def test_read_rss_mib():
+    assert read_rss_mib() > 1.0  # a python process is bigger than a MiB
