@@ -24,22 +24,26 @@
 #   4. examples/ — both runnable end-to-end demos (federated training,
 #      federated analytics) must keep running as documented
 #   5. scripts/scenarios.py — churn-scenario smoke over the real REST
-#      stack: vanish-after-sharing (threshold reveal from survivors) and
-#      clerk-kill-mid-chunk (sqlite persistence across process death);
+#      stack: vanish-after-sharing (threshold reveal from survivors),
+#      clerk-kill-mid-chunk (sqlite persistence across process death),
+#      and saturated-frontend (429 storm under a pinned admission cap);
 #      banked artifacts must record byte-exact reveals
+#   6. scripts/bench_compare.py — throughput gate over banked bench
+#      artifacts (newest vs previous per rider family); advisory unless
+#      SDA_BENCH_GATE=1
 set -e
 cd "$(dirname "$0")"
 
-echo "=== ci 0/5: build native extension (Jenkinsfile 'build' stage) ==="
+echo "=== ci 0/6: build native extension (Jenkinsfile 'build' stage) ==="
 # in-place so the suite, bench.py, and the CLI all pick it up from the
 # checkout; the crypto plane falls back to Python if this fails, so a
 # missing toolchain degrades rates, not correctness
 python setup.py build_ext --inplace || echo "ci: native build failed; Python fallback paths will carry the crypto plane" >&2
 
-echo "=== ci 1/5: test suite + backend/binding matrix + ladder quick ==="
+echo "=== ci 1/6: test suite + backend/binding matrix + ladder quick ==="
 sh scripts/test-matrix.sh
 
-echo "=== ci 1b/5: serial-fallback smoke (SDA_WORKERS=1 exact path) ==="
+echo "=== ci 1b/6: serial-fallback smoke (SDA_WORKERS=1 exact path) ==="
 # the worker pool's serial short-circuit must stay the bit-for-bit
 # legacy path; pin it explicitly so a pool regression can't hide behind
 # the default (cpu_count) worker configuration the matrix runs under
@@ -47,7 +51,7 @@ SDA_WORKERS=1 JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_workpool.py tests/test_clerking_chunks.py \
     tests/test_reveal_chunks.py
 
-echo "=== ci 1c/5: wire-format matrix (binary default + JSON legacy leg) ==="
+echo "=== ci 1c/6: wire-format matrix (binary default + JSON legacy leg) ==="
 # the negotiated binary wire is the default transport on the hot routes;
 # the same suite must also hold with SDA_WIRE=json, which pins the legacy
 # JSON bodies end-to-end (the interop path older clients ride). The wire
@@ -57,13 +61,13 @@ JAX_PLATFORMS=cpu python -m pytest -q tests/test_wire.py tests/test_rest.py
 SDA_WIRE=json JAX_PLATFORMS=cpu python -m pytest -q \
     tests/test_wire.py tests/test_rest.py
 
-echo "=== ci 2/5: CLI acceptance walkthrough ==="
+echo "=== ci 2/6: CLI acceptance walkthrough ==="
 sh scripts/simple-cli-example.sh
 
-echo "=== ci 3/5: telemetry exposition gate (live /v1/metrics scrape) ==="
+echo "=== ci 3/6: telemetry exposition gate (live /v1/metrics scrape) ==="
 JAX_PLATFORMS=cpu python scripts/check_metrics.py
 
-echo "=== ci 3b/5: sustained-soak smoke (paced rounds + live sampler) ==="
+echo "=== ci 3b/6: sustained-soak smoke (paced rounds + live sampler) ==="
 # ~20 s of paced rounds against the live loopback REST plane with the
 # time-series sampler ticking every second: the banked artifact must
 # parse, hold a monotonic sample series, and record every round as
@@ -88,7 +92,7 @@ EOF
 JAX_PLATFORMS=cpu python scripts/trace_report.py "$SOAK_ART"/soak-*.json
 rm -rf "$SOAK_ART"
 
-echo "=== ci 4/5: runnable examples (user-facing docs must not rot) ==="
+echo "=== ci 4/6: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null
 python examples/federated_analytics.py >/dev/null
 python examples/secure_sum_fabric.py >/dev/null
@@ -97,11 +101,13 @@ python examples/secure_sum_fabric.py >/dev/null
 # a failure here is a real resilience bug, not flake (seeds printed)
 python scripts/crash_soak.py 3
 
-echo "=== ci 5/5: churn-scenario smoke (named scenarios over real REST) ==="
-# two representative cells from the churn harness: clerks vanishing after
-# the sharing phase (threshold reveal from survivors) and a clerk killed
-# mid-chunk then resurrected (sqlite persistence across process death).
-# The banked artifacts must say the reveal was byte-exact, not merely ok.
+echo "=== ci 5/6: churn-scenario smoke (named scenarios over real REST) ==="
+# three representative cells from the churn harness: clerks vanishing
+# after the sharing phase (threshold reveal from survivors), a clerk
+# killed mid-chunk then resurrected (sqlite persistence across process
+# death), and a frontend pinned to a one-request admission cap shedding
+# a burst storm with 429s while the round still completes. The banked
+# artifacts must say the reveal was byte-exact, not merely ok.
 SCEN_ART="$(mktemp -d)"
 JAX_PLATFORMS=cpu python scripts/scenarios.py \
     --scenarios vanish-after-sharing --stores mem --transports rest \
@@ -109,15 +115,33 @@ JAX_PLATFORMS=cpu python scripts/scenarios.py \
 JAX_PLATFORMS=cpu python scripts/scenarios.py \
     --scenarios clerk-kill-mid-chunk --stores sqlite --transports rest \
     --artifacts "$SCEN_ART"
+JAX_PLATFORMS=cpu python scripts/scenarios.py \
+    --scenarios saturated-frontend --stores mem --transports rest \
+    --artifacts "$SCEN_ART"
 python - "$SCEN_ART" <<'EOF'
 import json, pathlib, sys
 arts = sorted(pathlib.Path(sys.argv[1]).glob("scenario-*.json"))
-assert len(arts) >= 2, f"expected two scenario artifacts, found {arts}"
+assert len(arts) >= 3, f"expected three scenario artifacts, found {arts}"
 for f in arts:
     d = json.loads(f.read_text())
     assert d["ok"] and d["exact"] is True, f"{f.name}: {d}"
+sat = [json.loads(f.read_text()) for f in arts if "saturated" in f.name]
+assert sat and sat[0]["details"]["sheds"] >= 1, "saturated cell never shed"
 print(f"ci: {len(arts)} scenario artifacts banked, all exact")
 EOF
 rm -rf "$SCEN_ART"
+
+echo "=== ci 6/6: bench throughput gate (newest vs previous artifacts) ==="
+# advisory by default: compare the two newest banked artifacts per rider
+# family and report any throughput drop beyond the threshold; export
+# SDA_BENCH_GATE=1 to make a regression fail the build
+if python scripts/bench_compare.py bench-artifacts; then
+    :
+elif [ "${SDA_BENCH_GATE:-0}" = "1" ]; then
+    echo "ci: bench throughput regressed and SDA_BENCH_GATE=1 — failing" >&2
+    exit 1
+else
+    echo "ci: bench throughput regression reported (advisory; set SDA_BENCH_GATE=1 to enforce)" >&2
+fi
 
 echo "=== ci: all gates passed ==="

@@ -84,6 +84,18 @@ def _metrics_pipeline(d: dict) -> dict:
     return {"best_encryptions_per_s": float(max(rates))} if rates else {}
 
 
+def _metrics_shard(d: dict) -> dict:
+    """shard-*: ingest rate per frontend count (legs keyed k1/k2/k4)."""
+    out = {}
+    legs = d.get("legs") if isinstance(d.get("legs"), dict) else {}
+    for name, leg in legs.items():
+        if isinstance(leg, dict) and isinstance(
+            leg.get("ingest_per_s"), (int, float)
+        ):
+            out[f"{name}_ingest_per_s"] = float(leg["ingest_per_s"])
+    return out
+
+
 def _metrics_soak(d: dict) -> dict:
     out = {}
     summary = d.get("summary") if isinstance(d.get("summary"), dict) else {}
@@ -101,6 +113,7 @@ RIDERS = {
     "committee": ("committee-*.json", _metrics_committee),
     "wire": ("wire-*.json", _metrics_wire),
     "soak": ("soak-*.json", _metrics_soak),
+    "shard": ("shard-*.json", _metrics_shard),
 }
 
 

@@ -23,9 +23,18 @@ Banks ``soak-<stamp>.json`` into the artifact dir with:
   artifact;
 - ``fault_counters``: injected-fault and client-retry totals (nonzero
   only when ``SDA_FAULTS`` shapes the run);
+- ``admission``: ``sda_rest_shed_total`` sum and per-route split —
+  nonzero only when ``--max-inflight`` caps the frontends and
+  ``--submit-workers`` bursts hard enough to trip it;
 - ``sampler_overhead_pct``: a sampler-off vs sampler-on A/B over
   ``--ab-rounds`` unpaced rounds each (PR-2 telemetry-A/B shape); the
   background scrape must cost < 2%.
+
+``--frontends N`` serves the same shared service from N REST frontends;
+the multi-root client hashes each aggregation id to a home frontend and
+fails over on connect errors.  The banked ``samples`` series is bounded
+at ``SDA_SOAK_MAX_SAMPLES`` entries (newest kept, rest thinned at a
+uniform stride).
 
 The server runs with ``SDA_TS=0`` — the script owns the global sampler
 explicitly so the A/B legs can hold it stopped — and the live
@@ -35,6 +44,8 @@ window is served over the wire, not just in memory.
 Usage:
   python scripts/load_soak.py --duration 60                 # the default soak
   python scripts/load_soak.py --duration 20 --rate 40 --interval 1  # CI smoke
+  python scripts/load_soak.py --duration 20 --frontends 3 \
+      --max-inflight 1 --submit-workers 8   # multi-frontend, shedding
 """
 
 from __future__ import annotations
@@ -58,16 +69,18 @@ DIM = 4
 MODULUS = 100003
 
 
-def build_stack(tmp: pathlib.Path, base_url: str):
+def build_stack(tmp: pathlib.Path, roots):
     """Recipient + committee + one pinned-rate participant, registered
-    once against the live server; rounds reuse these identities."""
+    once against the live server; rounds reuse these identities.
+    ``roots`` may be a single base URL or a list (multi-frontend soak) —
+    the client hashes aggregation ids across the list."""
     from sda_tpu.client import SdaClient
     from sda_tpu.crypto import Keystore
     from sda_tpu.rest import SdaHttpClient, TokenStore
 
     def new_client(name):
         keystore = Keystore(str(tmp / name))
-        service = SdaHttpClient(base_url, TokenStore(str(tmp / name)))
+        service = SdaHttpClient(roots, TokenStore(str(tmp / name)))
         return SdaClient(SdaClient.new_agent(keystore), keystore, service)
 
     recipient = new_client("recipient")
@@ -113,10 +126,19 @@ def new_round_aggregation(recipient, rkey, clerks, tag: str):
     return agg
 
 
-def run_round(ix: int, stack, round_size: int, rate: float | None) -> dict:
-    """One full paced round; returns the per-round record. Raises on an
+def run_round(ix: int, stack, round_size: int, rate: float | None,
+              submit_services=None) -> dict:
+    """One full round; returns the per-round record. Raises on an
     inexact reveal — a soak that silently aggregates wrong numbers is
-    worse than one that stops."""
+    worse than one that stops.
+
+    Submission is paced sequentially by default.  With
+    ``submit_services`` (one extra REST client per worker) the round
+    submits concurrently and unpaced instead — the burst shape that can
+    actually trip admission control; paced one-at-a-time arrivals never
+    exceed one in-flight request, so they can never shed."""
+    import concurrent.futures
+
     from sda_tpu import telemetry
 
     recipient, rkey, clerks, participant = stack
@@ -128,18 +150,33 @@ def run_round(ix: int, stack, round_size: int, rate: float | None) -> dict:
         agg = new_round_aggregation(recipient, rkey, clerks, str(ix))
         with telemetry.span("ingest.build", rows=round_size):
             parts = participant.new_participations(values, agg.id)
-        # pinned arrival: one submission per 1/rate seconds, absolute
-        # schedule (sleep to the slot, not after the previous request) so
-        # a slow request doesn't silently lower the offered rate
         t0 = time.perf_counter()
-        interarrival = (1.0 / rate) if rate else 0.0
-        for i, p in enumerate(parts):
-            if interarrival:
-                delay = t0 + i * interarrival - time.perf_counter()
-                if delay > 0:
-                    time.sleep(delay)
-            with telemetry.span("ingest.upload", rows=1):
-                participant.upload_participation(p)
+        if submit_services:
+            # concurrent burst: each worker drains its slice flat-out on
+            # its own client; 429s surface as client-side paced retries
+            # (sda_rest_retries_total), sheds tick sda_rest_shed_total
+            def drain(worker_ix):
+                service = submit_services[worker_ix]
+                for p in parts[worker_ix::len(submit_services)]:
+                    with telemetry.span("ingest.upload", rows=1):
+                        service.create_participation(participant.agent, p)
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=len(submit_services)) as pool:
+                for f in [pool.submit(drain, w)
+                          for w in range(len(submit_services))]:
+                    f.result()
+        else:
+            # pinned arrival: one submission per 1/rate seconds, absolute
+            # schedule (sleep to the slot, not after the previous request)
+            # so a slow request doesn't silently lower the offered rate
+            interarrival = (1.0 / rate) if rate else 0.0
+            for i, p in enumerate(parts):
+                if interarrival:
+                    delay = t0 + i * interarrival - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                with telemetry.span("ingest.upload", rows=1):
+                    participant.upload_participation(p)
         ingest_s = time.perf_counter() - t0
         recipient.end_aggregation(agg.id)
         for c in clerks:
@@ -220,6 +257,36 @@ def fault_counters() -> dict:
     return out
 
 
+def admission_counters() -> dict:
+    """Shed totals (sum + per-route split) — the frontends run in-process,
+    so their sda_rest_shed_total ticks land in this same registry.
+    Nonzero only when SDA_REST_MAX_INFLIGHT caps the run."""
+    from sda_tpu import telemetry
+
+    total, by_route = 0, {}
+    snap = telemetry.get_registry().snapshot()
+    for (name, labels), value in snap["counters"].items():
+        if name == "sda_rest_shed_total":
+            total += value
+            route = dict(labels).get("route", "?")
+            by_route[route] = by_route.get(route, 0) + value
+    return {"sda_rest_shed_total": total, "by_route": by_route}
+
+
+def downsample(samples: list, cap: int) -> list:
+    """Bound the banked sample series at ``cap`` entries: always keep the
+    newest sample, and thin the rest with a uniform stride so the window
+    still spans the whole soak.  Long soaks otherwise bank megabytes of
+    per-interval snapshots."""
+    if cap <= 0 or len(samples) <= cap:
+        return samples
+    if cap == 1:
+        return [samples[-1]]
+    head, newest = samples[:-1], samples[-1]
+    kept = [head[i * len(head) // (cap - 1)] for i in range(cap - 1)]
+    return kept + [newest]
+
+
 def summarize(samples: list) -> dict:
     """Headline numbers over the banked window: mean/max total rps, the
     worst windowed p99 per hot route, and the RSS trajectory."""
@@ -262,10 +329,28 @@ def main() -> int:
     ap.add_argument("--ab-rounds", type=int, default=3,
                     help="rounds per arm of the sampler overhead A/B "
                          "(0 skips it; default 3)")
+    ap.add_argument("--frontends", type=int, default=1, metavar="N",
+                    help="serve N REST frontends over the one shared "
+                         "service; the client hashes aggregation ids "
+                         "across them (default 1)")
+    ap.add_argument("--max-inflight", type=int, default=0,
+                    help="per-frontend admission cap (exported as "
+                         "SDA_REST_MAX_INFLIGHT; 0 = off, the default)")
+    ap.add_argument("--queue-high-water", type=int, default=0,
+                    help="extra admitted-but-queued slack above the cap "
+                         "(SDA_REST_QUEUE_HIGH_WATER; default 0)")
+    ap.add_argument("--submit-workers", type=int, default=1, metavar="W",
+                    help="submit each round concurrently from W clients "
+                         "instead of paced one-at-a-time — the burst "
+                         "shape that exercises admission control "
+                         "(default 1 = sequential paced)")
     ap.add_argument("--artifacts", default=str(REPO / "bench-artifacts"))
     args = ap.parse_args()
 
     os.environ["SDA_TS_INTERVAL_S"] = str(args.interval)
+    if args.max_inflight > 0:
+        os.environ["SDA_REST_MAX_INFLIGHT"] = str(args.max_inflight)
+        os.environ["SDA_REST_QUEUE_HIGH_WATER"] = str(args.queue_high_water)
     # paged delivery so the clerk/reveal pipeline spans (the flight
     # recorder's clerking + reveal tracks) appear in every round
     os.environ.setdefault("SDA_JOB_PAGE_THRESHOLD", "0")
@@ -273,8 +358,10 @@ def main() -> int:
     os.environ.setdefault("SDA_RESULT_PAGE_THRESHOLD", "0")
     os.environ.setdefault("SDA_RESULT_CHUNK_SIZE", "32")
 
+    import contextlib
+
     from sda_tpu import telemetry
-    from sda_tpu.rest import serve_background
+    from sda_tpu.rest import serve_background, serve_background_multi
     from sda_tpu.server import new_mem_server
     from sda_tpu.telemetry import timeseries
 
@@ -289,15 +376,32 @@ def main() -> int:
             "rate": args.rate,
             "round_size": args.round_size,
             "interval_s": args.interval,
+            "frontends": args.frontends,
+            "max_inflight": args.max_inflight,
+            "queue_high_water": args.queue_high_water,
+            "submit_workers": args.submit_workers,
             "faults": os.environ.get("SDA_FAULTS"),
         },
     }
     server = new_mem_server()
-    with serve_background(server) as base_url, \
-            tempfile.TemporaryDirectory() as td:
-        tmp = pathlib.Path(td)
-        stack = build_stack(tmp, base_url)
+    with contextlib.ExitStack() as ctx:
+        if args.frontends > 1:
+            roots = ctx.enter_context(
+                serve_background_multi(server, args.frontends))
+        else:
+            roots = ctx.enter_context(serve_background(server))
+        tmp = pathlib.Path(ctx.enter_context(tempfile.TemporaryDirectory()))
+        stack = build_stack(tmp, roots)
         http = stack[3].service  # the participant's SdaHttpClient
+        submit_services = None
+        if args.submit_workers > 1:
+            # one extra client per worker, sharing the participant's
+            # token dir (tokens are negotiated once and cached on disk)
+            from sda_tpu.rest import SdaHttpClient, TokenStore
+            submit_services = [
+                SdaHttpClient(roots, TokenStore(str(tmp / "participant")))
+                for _ in range(args.submit_workers)
+            ]
 
         record["sampler_ab"] = measure_sampler_overhead(
             stack, args.round_size, args.ab_rounds, args.interval
@@ -316,7 +420,8 @@ def main() -> int:
             deadline = time.monotonic() + args.duration
             ix = 0
             while time.monotonic() < deadline:
-                rounds.append(run_round(ix, stack, args.round_size, args.rate))
+                rounds.append(run_round(ix, stack, args.round_size,
+                                        args.rate, submit_services))
                 print(f"[soak] round {ix}: {rounds[-1]['round_s']}s, "
                       f"arrival {rounds[-1]['rate_achieved']}/s, exact",
                       file=sys.stderr)
@@ -332,9 +437,15 @@ def main() -> int:
             timeseries.release()
 
         record["rounds"] = rounds
-        record["samples"] = samples
+        # summary over the FULL window; the banked series itself is
+        # bounded at SDA_SOAK_MAX_SAMPLES (newest kept, rest thinned at
+        # a uniform stride) so long soaks don't bank megabytes
+        max_samples = int(os.environ.get("SDA_SOAK_MAX_SAMPLES", "2000"))
         record["summary"] = summarize(samples)
+        record["samples_total"] = len(samples)
+        record["samples"] = downsample(samples, max_samples)
         record["fault_counters"] = fault_counters()
+        record["admission"] = admission_counters()
         record["history_route"] = {
             "running": history.get("running"),
             "samples_served": len(history.get("samples", [])),
@@ -354,8 +465,9 @@ def main() -> int:
 
     s = record["summary"]
     print(f"[soak] {len(record['rounds'])} rounds ({exact} exact), "
-          f"{len(record['samples'])} samples, "
+          f"{len(record['samples'])}/{record['samples_total']} samples banked, "
           f"rps mean {s['rps_mean']} max {s['rps_max']}, "
+          f"sheds {record['admission']['sda_rest_shed_total']}, "
           f"rss {s['rss_mib']['start']} -> {s['rss_mib']['end']} MiB "
           f"(peak {s['rss_mib']['peak']})", file=sys.stderr)
     print(path)

@@ -27,6 +27,10 @@ Scenarios:
   duplicate-replay-malformed  duplicate + replayed submissions under
                             concurrent load are absorbed (counted once),
                             malformed ones rejected at the door
+  saturated-frontend        a burst storm against a frontend pinned to
+                            SDA_REST_MAX_INFLIGHT=1 sheds with 429 +
+                            Retry-After; the backoff client paces every
+                            retry and the round still reveals exactly
 
 Each cell banks ``scenario-<name>-...-<store>-<transport>.json`` into the
 artifact dir (default bench-artifacts/); scripts/sweep_report.py rolls
@@ -539,12 +543,117 @@ def scenario_duplicate_replay_malformed(dep: Deployment, seed: int) -> dict:
     return {"participants": n, "uploads_per_participation": 4, "aggregate": aggregate}
 
 
+class _RestView:
+    """Deployment facade that routes EVERY client through a REST
+    frontend URL — saturated-frontend uses it to put inproc cells behind
+    an in-process frontend, so the 429 plane is exercised on all six
+    store x transport cells."""
+
+    def __init__(self, tmp: pathlib.Path, url: str):
+        self.tmp = tmp
+        self.url = url
+
+    def client(self, name: str):
+        from test_shared_store import _http_client
+
+        return persistent_client(
+            self.tmp / f"id-{name}",
+            _http_client(self.tmp / f"tok-{name}", self.url),
+        )
+
+
+def scenario_saturated_frontend(dep: Deployment, seed: int) -> dict:
+    """A 429 storm: the runner pins SDA_REST_MAX_INFLIGHT=1 (+1 queued)
+    around this cell, then 8 participants hammer the frontend with
+    concurrent idempotent submissions.  The frontend must shed with
+    429 + Retry-After (counted via the exempt /v1/metrics route), the
+    backoff client must absorb every shed as a paced retry, and the
+    round must reveal exactly — saturation degrades latency, never
+    correctness."""
+    import re
+
+    import requests
+
+    from sda_tpu.protocol import AdditiveSharing
+
+    with contextlib.ExitStack() as ctx:
+        if dep.transport == "rest":
+            url = dep.url  # sdad subprocess inherited the admission env
+        else:
+            from sda_tpu.rest import serve_background
+
+            url = ctx.enter_context(serve_background(dep._server))
+        view = _RestView(dep.tmp, url)
+
+        recipient, clerks, agg = _setup_round(
+            view, AdditiveSharing(share_count=2, modulus=MODULUS), _chacha()
+        )
+        n = 8
+        participants = [view.client(f"part-{i}") for i in range(n)]
+        for c in participants:
+            c.upload_agent()
+        values = [[i, 1, (2 * i) % 5, 0] for i in range(n)]
+        built = [
+            c.new_participations([v], agg.id)[0]
+            for c, v in zip(participants, values)
+        ]
+
+        # the storm: every participant submits its (idempotent)
+        # participation 4x from its own thread — bursts of 8 concurrent
+        # requests against an admitted ceiling of 2
+        barrier = threading.Barrier(n)
+        errors: list = []
+
+        def hammer(ix):
+            try:
+                barrier.wait()
+                for _ in range(4):
+                    participants[ix].service.create_participation(
+                        participants[ix].agent, built[ix]
+                    )
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((ix, repr(e)))
+
+        threads = [threading.Thread(target=hammer, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise AssertionError(f"storm submissions not absorbed: {errors}")
+
+        # sheds, from the server's own registry over the exempt metrics
+        # route — reachable even while the data plane is saturated
+        text = requests.get(f"{url}/v1/metrics", timeout=10).text
+        sheds = sum(
+            int(float(v)) for v in
+            re.findall(r'^sda_rest_shed_total\{[^}]*\} (\S+)', text, re.M)
+        )
+        if sheds < 1:
+            raise AssertionError(
+                "storm never tripped admission control (0 sheds)"
+            )
+
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
+        aggregate = _reveal_exact(recipient, agg, expected)
+    return {
+        "participants": n,
+        "storm_requests": 4 * n,
+        "sheds": sheds,
+        "aggregate": aggregate,
+    }
+
+
 SCENARIOS = {
     "register-never-submit": scenario_register_never_submit,
     "submit-mid-snapshot": scenario_submit_mid_snapshot,
     "vanish-after-sharing": scenario_vanish_after_sharing,
     "clerk-kill-mid-chunk": scenario_clerk_kill_mid_chunk,
     "duplicate-replay-malformed": scenario_duplicate_replay_malformed,
+    "saturated-frontend": scenario_saturated_frontend,
 }
 
 #: per-scenario env the runner scopes around the cell (clerk-kill needs
@@ -553,6 +662,15 @@ _SCENARIO_ENV = {
     "clerk-kill-mid-chunk": {
         "SDA_JOB_PAGE_THRESHOLD": "0",
         "SDA_JOB_CHUNK_SIZE": "3",
+    },
+    # a tiny admission ceiling (1 executing + 1 queued) so an 8-wide
+    # burst must shed; short Retry-After and a deep retry budget keep
+    # the storm fast and every shed absorbable
+    "saturated-frontend": {
+        "SDA_REST_MAX_INFLIGHT": "1",
+        "SDA_REST_QUEUE_HIGH_WATER": "1",
+        "SDA_REST_RETRY_AFTER_S": "0.05",
+        "SDA_REST_RETRIES": "8",
     },
 }
 

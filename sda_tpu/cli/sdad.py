@@ -31,6 +31,14 @@ def build_parser() -> argparse.ArgumentParser:
     backend.add_argument("--file", metavar="ROOT", help="durable JSON-file store root")
     backend.add_argument("--sqlite", metavar="DB", help="sqlite database path (production)")
     backend.add_argument("--mem", action="store_true", help="in-memory store (dev)")
+    parser.add_argument(
+        "--shards",
+        type=int,
+        default=1,
+        metavar="K",
+        help="partition aggregation state over K store shards "
+        "(file/sqlite paths become per-shard roots under the given path)",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd", help="run the REST server")
     httpd.add_argument("-b", "--bind", default="127.0.0.1:8888", metavar="IP:PORT")
@@ -119,7 +127,20 @@ def main(argv=None) -> int:
     if args.command == "committee":
         return run_committee_daemon(args)
 
-    if args.file:
+    shards = max(int(args.shards or 1), 1)
+    if shards > 1:
+        from ..server import new_sharded_server
+
+        if args.file:
+            service = new_sharded_server("file", shards, args.file)
+            log.info("using file store at %s over %d shards", args.file, shards)
+        elif args.sqlite:
+            service = new_sharded_server("sqlite", shards, args.sqlite)
+            log.info("using sqlite store at %s over %d shards", args.sqlite, shards)
+        else:
+            service = new_sharded_server("mem", shards)
+            log.info("using in-memory store over %d shards", shards)
+    elif args.file:
         service = new_file_server(args.file)
         log.info("using file store at %s", args.file)
     elif args.sqlite:

@@ -1,0 +1,33 @@
+"""JAX API compatibility for the mesh plane.
+
+``shard_map`` has moved across JAX releases: newer builds expose
+``jax.shard_map`` at top level, while 0.4.x ships it only as
+``jax.experimental.shard_map.shard_map``. Every mesh call site (engine,
+sum-first fabric, multihost, and the test-suite capability probe) routes
+through this resolver so the whole plane agrees on one binding — a repo
+that half-works on a given JAX build is worse than one that cleanly
+skips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x: experimental namespace only
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(f, *args, **kwargs):
+        # The experimental API spells the replication check ``check_rep``;
+        # the top-level API renamed it ``check_vma``. Call sites use the
+        # modern spelling, so translate here.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, *args, **kwargs)
+
+
+__all__ = ["shard_map"]

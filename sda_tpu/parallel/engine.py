@@ -43,6 +43,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import telemetry
+from . import compat
 from ..ops import shamir
 from ..ops.jaxcfg import ensure_x64
 from ..protocol import AdditiveSharing, BasicShamirSharing, PackedShamirSharing
@@ -379,7 +380,7 @@ class TpuAggregator:
             # all participants sum locally — wide-safe reduction
             return clerk_combine_mod(resharded, modulus)  # (n/p, B)
 
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(P("p", None), P()),
@@ -434,7 +435,7 @@ class TpuAggregator:
         import jax
         from jax.sharding import PartitionSpec as P
 
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             self._limb_accumulator_local_step(("p",)),
             mesh=self.mesh,
             # in_specs requires a "d" axis, so no d-less fallback here
@@ -473,7 +474,7 @@ class TpuAggregator:
             total = lax.psum(partial, axis_name="p")
             return lax.rem(total, jnp.int64(modulus))
 
-        mapped = jax.shard_map(
+        mapped = compat.shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(P("p", "d"), P()),

@@ -157,8 +157,10 @@ def hierarchical_clerk_sums(scheme, dim: int, mesh):
         total = lax.psum(partial, axis_name="h")
         return lax.rem(total, jnp.int64(plan.modulus))
 
+    from . import compat
+
     d_spec = "d" if "d" in mesh.axis_names else None
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(("h", "p"), d_spec), P()),
@@ -184,13 +186,14 @@ def hierarchical_limb_accumulators(scheme, dim: int, mesh):
     import jax
     from jax.sharding import PartitionSpec as P
 
+    from . import compat
     from .engine import TpuAggregator
 
     agg = TpuAggregator(scheme, dim, mesh=mesh)
     agg.validate_d_sharding(dim)
 
     d_spec = "d" if "d" in mesh.axis_names else None
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         # ICI ("p") before DCN ("h"): only the tiny accumulator crosses hosts
         agg._limb_accumulator_local_step(("p", "h")),
         mesh=mesh,

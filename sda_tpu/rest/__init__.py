@@ -3,7 +3,13 @@ plus the negotiated binary wire codec the hot routes ride (``wire``)."""
 
 from . import wire
 from .client import SdaHttpClient
-from .server import listen, make_handler, serve_background, serve_forever
+from .server import (
+    listen,
+    make_handler,
+    serve_background,
+    serve_background_multi,
+    serve_forever,
+)
 from .tokenstore import TokenStore
 
 __all__ = [
@@ -12,6 +18,7 @@ __all__ = [
     "listen",
     "make_handler",
     "serve_background",
+    "serve_background_multi",
     "serve_forever",
     "wire",
 ]

@@ -15,6 +15,18 @@ clerking-job / snapshot-result chunk GETs — default to the negotiated
 it via ``Accept`` and parse whatever Content-Type the server answers
 with, so a JSON-only server downgrades transparently. ``SDA_WIRE=json``
 forces the legacy JSON bodies on every route.
+
+Multi-frontend routing: constructed with a *list* of base URLs, the
+client becomes its own router over the sharded coordination plane —
+aggregation-keyed requests hash their aggregation id on the same
+``HashRing`` the server-side ``ShardedStore`` uses (``route_key``
+threading below), so one aggregation's traffic converges on one frontend
+without coordination; unkeyed requests pin to the first frontend. A
+frontend that fails at the transport level is quarantined for
+``SDA_REST_QUARANTINE_S`` and the request falls over to the next
+frontend in the key's ring-preference order; 429 (admission shed) is
+pacing, not failure — it backs off against the *same* frontend honoring
+Retry-After, preserving routing locality under saturation.
 """
 
 from __future__ import annotations
@@ -81,9 +93,21 @@ def _retry_after_cap_s() -> float:
     return float(os.environ.get("SDA_REST_RETRY_AFTER_CAP_S", "30.0"))
 
 
+def _quarantine_s() -> float:
+    """How long a frontend that failed at the transport level sits out of
+    the candidate rotation (``SDA_REST_QUARANTINE_S``, default 3.0) — long
+    enough that a dead frontend is not re-probed on every request, short
+    enough that a restarted one rejoins promptly."""
+    try:
+        return max(0.0, float(os.environ.get("SDA_REST_QUARANTINE_S", "3.0")))
+    except ValueError:
+        return 3.0
+
+
 #: transient server-side statuses worth retrying; 4xx are the caller's
-#: fault and never retried
-_RETRYABLE_STATUSES = (500, 502, 503, 504)
+#: fault and never retried — except 429, which is the admission-control
+#: plane explicitly asking for a paced retry (Retry-After honored)
+_RETRYABLE_STATUSES = (429, 500, 502, 503, 504)
 
 
 def _retry_after_s(resp) -> float:
@@ -99,9 +123,23 @@ def _retry_after_s(resp) -> float:
 
 
 class SdaHttpClient(SdaService):
-    def __init__(self, server_root: str, token_store,
+    def __init__(self, server_root, token_store,
                  timeout: float | None = DEFAULT_TIMEOUT_S):
-        self.server_root = server_root.rstrip("/")
+        """``server_root`` is one base URL, or a list of them (one per
+        frontend of a sharded deployment, in frontend order — the order
+        the ring indexes into; every client must agree on it)."""
+        roots = [server_root] if isinstance(server_root, str) else list(server_root)
+        if not roots:
+            raise ValueError("SdaHttpClient needs at least one server root")
+        self.roots = [r.rstrip("/") for r in roots]
+        self.server_root = self.roots[0]
+        self._ring = None
+        if len(self.roots) > 1:
+            from ..utils.hashring import HashRing
+
+            self._ring = HashRing(len(self.roots))
+        #: root -> monotonic quarantine expiry (transport failures only)
+        self._quarantined = {}
         self.token_store = token_store
         self.timeout = timeout
         self.session = requests.Session()
@@ -117,10 +155,26 @@ class SdaHttpClient(SdaService):
 
     # -- plumbing -----------------------------------------------------------
 
+    def _candidate_roots(self, route_key) -> list:
+        """Frontend base URLs in try-order for this request: the key's
+        ring-preference order (or plain frontend order when unkeyed),
+        with currently-quarantined frontends demoted to the back — never
+        dropped, so a fully-quarantined plane still tries everything."""
+        if len(self.roots) == 1:
+            return self.roots
+        if route_key is not None and self._ring is not None:
+            ordered = [self.roots[ix] for ix in self._ring.preference(str(route_key))]
+        else:
+            ordered = list(self.roots)
+        now = time.monotonic()
+        live = [r for r in ordered if self._quarantined.get(r, 0.0) <= now]
+        dead = [r for r in ordered if self._quarantined.get(r, 0.0) > now]
+        return live + dead
+
     def _request(self, method: str, path: str, caller=None, body=None, params=None,
                  idempotent: bool | None = None, raw_body: bytes | None = None,
                  content_type: str | None = None, accept: str | None = None,
-                 raw: bool = False):
+                 raw: bool = False, route_key=None):
         """One protocol call, with transient-failure hardening.
 
         ``raw_body``/``content_type`` send a pre-encoded body (the binary
@@ -135,13 +189,19 @@ class SdaHttpClient(SdaService):
         snapshot no-op) pass ``idempotent=True`` to opt in — a replayed
         create either matches byte-for-byte (absorbed) or conflicts
         (fails like the first attempt would have). Retries cover
-        transport failures and transient 5xx only, with full-jitter
-        exponential backoff floored by the server's Retry-After; 4xx are
-        never retried.
+        transport failures and transient 5xx/429 only, with full-jitter
+        exponential backoff floored by the server's Retry-After; other
+        4xx are never retried.
+
+        ``route_key`` (an aggregation id, usually) picks the frontend on
+        a multi-root client; a transport failure quarantines the frontend
+        and the retry falls over to the next one in ring order, while a
+        retryable *status* stays on the same frontend (it answered).
         """
-        url = self.server_root + path
-        if params:
-            url += "?" + urlencode(params)
+        query = "?" + urlencode(params) if params else ""
+        candidates = self._candidate_roots(route_key)
+        root_ix = 0
+        url = candidates[0] + path + query
         auth = (str(caller.id), self.token_store.get()) if caller is not None else None
         data = None
         headers = {}
@@ -190,6 +250,14 @@ class SdaHttpClient(SdaService):
                 )
             except requests.RequestException as exc:
                 if attempt + 1 < attempts:
+                    if len(candidates) > 1:
+                        # this frontend is unreachable: bench it and fall
+                        # over to the next one in the key's ring order
+                        self._quarantined[candidates[root_ix]] = (
+                            time.monotonic() + _quarantine_s()
+                        )
+                        root_ix = (root_ix + 1) % len(candidates)
+                        url = candidates[root_ix] + path + query
                     self._count_retry(method, path, "transport")
                     continue
                 # timeouts/connection failures join the documented error
@@ -316,12 +384,14 @@ class SdaHttpClient(SdaService):
         return [AggregationId(i) for i in obj]
 
     def get_aggregation(self, caller, aggregation_id):
-        obj = self._request("GET", f"/v1/aggregations/{quote(str(aggregation_id))}", caller)
+        obj = self._request("GET", f"/v1/aggregations/{quote(str(aggregation_id))}", caller,
+                            route_key=aggregation_id)
         return None if obj is None else Aggregation.from_json(obj)
 
     def get_committee(self, caller, aggregation_id):
         obj = self._request(
-            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/committee", caller
+            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/committee", caller,
+            route_key=aggregation_id,
         )
         return None if obj is None else Committee.from_json(obj)
 
@@ -329,49 +399,55 @@ class SdaHttpClient(SdaService):
 
     def create_aggregation(self, caller, aggregation) -> None:
         self._request("POST", "/v1/aggregations", caller, aggregation,
-                      idempotent=True)
+                      idempotent=True, route_key=aggregation.id)
 
     def delete_aggregation(self, caller, aggregation_id) -> None:
-        self._request("DELETE", f"/v1/aggregations/{quote(str(aggregation_id))}", caller)
+        self._request("DELETE", f"/v1/aggregations/{quote(str(aggregation_id))}", caller,
+                      route_key=aggregation_id)
 
     def suggest_committee(self, caller, aggregation_id):
         obj = self._request(
             "GET",
             f"/v1/aggregations/{quote(str(aggregation_id))}/committee/suggestions",
             caller,
+            route_key=aggregation_id,
         )
         return [ClerkCandidate.from_json(c) for c in obj]
 
     def create_committee(self, caller, committee) -> None:
         self._request("POST", "/v1/aggregations/implied/committee", caller,
-                      committee, idempotent=True)
+                      committee, idempotent=True, route_key=committee.aggregation)
 
     def get_aggregation_status(self, caller, aggregation_id):
         obj = self._request(
-            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/status", caller
+            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/status", caller,
+            route_key=aggregation_id,
         )
         return None if obj is None else AggregationStatus.from_json(obj)
 
     def create_snapshot(self, caller, snapshot) -> None:
         self._request("POST", "/v1/aggregations/implied/snapshot", caller,
-                      snapshot, idempotent=True)
+                      snapshot, idempotent=True, route_key=snapshot.aggregation)
 
     def get_snapshot_result(self, caller, aggregation_id, snapshot_id):
         obj = self._request(
             "GET",
             f"/v1/aggregations/{quote(str(aggregation_id))}/snapshots/{quote(str(snapshot_id))}/result",
             caller,
+            route_key=aggregation_id,
         )
         return None if obj is None else SnapshotResult.from_json(obj)
 
-    def _get_negotiated(self, path, caller, decode_binary, decode_json):
+    def _get_negotiated(self, path, caller, decode_binary, decode_json,
+                        route_key=None):
         """A chunk GET that prefers the binary wire format: advertise it
         via Accept (unless ``SDA_WIRE=json``), then parse by the response
         Content-Type — a JSON-only server downgrades transparently."""
         if wire.mode() != "binary":
-            obj = self._request("GET", path, caller)
+            obj = self._request("GET", path, caller, route_key=route_key)
             return None if obj is None else decode_json(obj)
-        resp = self._request("GET", path, caller, accept=wire.CONTENT_TYPE, raw=True)
+        resp = self._request("GET", path, caller, accept=wire.CONTENT_TYPE,
+                             raw=True, route_key=route_key)
         if resp is None:
             return None
         if wire.is_binary(resp.headers.get("Content-Type")):
@@ -392,6 +468,7 @@ class SdaHttpClient(SdaService):
             caller,
             wire.decode_encryptions,
             lambda obj: [Encryption.from_json(e) for e in obj],
+            route_key=aggregation_id,
         )
 
     def get_snapshot_result_clerks(self, caller, aggregation_id, snapshot_id, start):
@@ -403,13 +480,15 @@ class SdaHttpClient(SdaService):
             caller,
             wire.decode_clerking_results,
             lambda obj: [ClerkingResult.from_json(c) for c in obj],
+            route_key=aggregation_id,
         )
 
     # -- participation ------------------------------------------------------
 
     def create_participation(self, caller, participation) -> None:
         self._request("POST", "/v1/aggregations/participations", caller,
-                      participation, idempotent=True)
+                      participation, idempotent=True,
+                      route_key=participation.aggregation)
 
     def create_participations(self, caller, participations) -> None:
         """Batched submit: the whole array in one request on the batch
@@ -426,6 +505,7 @@ class SdaHttpClient(SdaService):
                 caller,
                 raw_body=wire.encode_participations(participations),
                 idempotent=True,
+                route_key=participations[0].aggregation if participations else None,
             )
         else:
             self._request(
@@ -434,12 +514,16 @@ class SdaHttpClient(SdaService):
                 caller,
                 [p.to_json() for p in participations],
                 idempotent=True,
+                route_key=participations[0].aggregation if participations else None,
             )
 
     # -- clerking -----------------------------------------------------------
 
     def get_clerking_job(self, caller, clerk_id):
-        obj = self._request("GET", "/v1/aggregations/any/jobs", caller)
+        # keyed by the polling clerk: spreads committee polling across
+        # frontends; any frontend can answer (server-side polls fan out)
+        obj = self._request("GET", "/v1/aggregations/any/jobs", caller,
+                            route_key=clerk_id)
         return None if obj is None else ClerkingJob.from_json(obj)
 
     def get_clerking_job_chunk(self, caller, job_id, start):
@@ -450,6 +534,7 @@ class SdaHttpClient(SdaService):
             caller,
             wire.decode_encryptions,
             lambda obj: [Encryption.from_json(e) for e in obj],
+            route_key=job_id,
         )
 
     def create_clerking_result(self, caller, result) -> None:
@@ -459,4 +544,5 @@ class SdaHttpClient(SdaService):
             caller,
             result,
             idempotent=True,
+            route_key=result.job,
         )

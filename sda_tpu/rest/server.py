@@ -140,6 +140,58 @@ def _slow_request_s() -> float:
         return 1.0
 
 
+def _max_inflight() -> int:
+    """Admission-control target for concurrently *executing* requests
+    (``SDA_REST_MAX_INFLIGHT``). 0 (the default) disables admission
+    control entirely — the frontend admits everything, exactly the
+    pre-sharding behaviour."""
+    try:
+        return max(0, int(os.environ.get("SDA_REST_MAX_INFLIGHT", "0")))
+    except ValueError:
+        return 0
+
+
+def _queue_high_water() -> int:
+    """Extra admitted-but-queued requests allowed on top of
+    ``SDA_REST_MAX_INFLIGHT`` before the frontend starts shedding
+    (``SDA_REST_QUEUE_HIGH_WATER``, default 0 = shed as soon as the
+    in-flight target is reached). Together the two knobs bound the
+    executor backlog: admitted = executing + queued <= max_inflight +
+    queue_high_water."""
+    try:
+        return max(0, int(os.environ.get("SDA_REST_QUEUE_HIGH_WATER", "0")))
+    except ValueError:
+        return 0
+
+
+def _retry_after_hint_s() -> float:
+    """Retry-After seconds a shed (429) response advertises
+    (``SDA_REST_RETRY_AFTER_S``, default 0.25). The PR-6 client honors it
+    as the backoff floor, so a saturated frontend paces its own retry
+    storm without the client guessing."""
+    try:
+        return max(0.0, float(os.environ.get("SDA_REST_RETRY_AFTER_S", "0.25")))
+    except ValueError:
+        return 0.25
+
+
+#: routes admission control never sheds: liveness/readiness probes and
+#: the metrics planes must answer *especially* when the frontend is
+#: saturated — a 429'd readyz would make the balancer drain the node
+#: for being busy, and a 429'd scrape would blind the operator to the
+#: very saturation being shed
+_ADMISSION_EXEMPT = frozenset(
+    {
+        "/v1/ping",
+        "/v1/healthz",
+        "/v1/readyz",
+        "/v1/metrics",
+        "/v1/metrics.json",
+        "/v1/metrics/history",
+    }
+)
+
+
 def _worker_count() -> int:
     """Executor threads that run the (synchronous) service layer
     (``SDA_REST_WORKERS``). Unlike the old thread-per-connection model
@@ -746,6 +798,9 @@ class SdaRestServer:
         self._executor = None
         self._writers = set()
         self._conn_tasks = set()
+        #: requests admitted to the executor (executing + queued); only
+        #: touched on the event loop, so a plain int is race-free
+        self._inflight = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -902,10 +957,8 @@ class SdaRestServer:
                         reader.readexactly(length), timeout=idle
                     )
 
-            response = await loop.run_in_executor(
-                self._executor, self.router.handle,
-                method, target, headers, body, body_error,
-            )
+            response = await self._dispatch(loop, method, target, headers,
+                                            body, body_error)
             if response.drop:
                 return  # injected connection death: no bytes at all
             if version != "HTTP/1.1" or headers.get("connection", "").lower() == "close":
@@ -913,6 +966,48 @@ class SdaRestServer:
             await self._write_response(writer, response)
             if response.close:
                 return
+
+    async def _dispatch(self, loop, method, target, headers, body, body_error):
+        """Admission control, then the executor. The body is already
+        fully read, so shedding answers without consuming a worker
+        thread — and the keep-alive stream stays in sync either way."""
+        max_inflight = _max_inflight()
+        if max_inflight:
+            path = target.partition("?")[0]
+            if (
+                self._inflight >= max_inflight + _queue_high_water()
+                and path not in _ADMISSION_EXEMPT
+            ):
+                return self._shed(method, path)
+        self._inflight += 1
+        try:
+            return await loop.run_in_executor(
+                self._executor, self.router.handle,
+                method, target, headers, body, body_error,
+            )
+        finally:
+            self._inflight -= 1
+
+    def _shed(self, method: str, path: str) -> _Response:
+        route = re.sub(_UUID, "{id}", path)
+        if telemetry.enabled():
+            telemetry.counter(
+                "sda_rest_shed_total",
+                "requests shed with 429 by admission control, by route template",
+                route=route,
+            ).inc()
+        log.debug(
+            "shedding %s %s: %d in flight (max %d + queue %d)",
+            method, path, self._inflight, _max_inflight(), _queue_high_water(),
+        )
+        return _Response(
+            429,
+            [
+                ("Retry-After", f"{_retry_after_hint_s():g}"),
+                ("Content-Type", "text/plain"),
+            ],
+            b"server saturated; retry later",
+        )
 
     @staticmethod
     async def _write_response(writer, response: _Response):
@@ -969,3 +1064,28 @@ def serve_background(service, host: str = "127.0.0.1", port: int = 0):
         httpd.shutdown()
         httpd.server_close()
         thread.join(timeout=5)
+
+
+@contextlib.contextmanager
+def serve_background_multi(service, frontends: int, host: str = "127.0.0.1"):
+    """Run ``frontends`` REST servers over one (typically sharded)
+    service, each on its own daemon thread and kernel-assigned port;
+    yields the list of base URLs in frontend order — the order the
+    client-side router's hash ring indexes into. In-process frontends
+    share the GIL, so this is the *coordination* shape (routing,
+    failover, admission control) rather than a CPU-scaling one; the
+    bench rider spawns separate ``sdad`` processes for honest scaling."""
+    httpds = [listen((host, 0), service) for _ in range(frontends)]
+    threads = [
+        threading.Thread(target=h.serve_forever, daemon=True) for h in httpds
+    ]
+    for t in threads:
+        t.start()
+    try:
+        yield [f"http://{h.server_address[0]}:{h.server_address[1]}" for h in httpds]
+    finally:
+        for h in httpds:
+            h.shutdown()
+            h.server_close()
+        for t in threads:
+            t.join(timeout=5)

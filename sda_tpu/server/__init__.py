@@ -67,6 +67,85 @@ def new_file_server(path) -> SdaServerService:
     )
 
 
+def new_sharded_server(kind: str, shards: int, path=None) -> SdaServerService:
+    """Server over K store partitions routed by aggregation id.
+
+    ``kind`` picks the backend for every partition (``mem`` / ``file`` /
+    ``sqlite``; the latter two lay partitions out under ``path`` as
+    ``shard-NN`` dirs / ``shard-NN.db`` files). Agents and auth tokens —
+    the small global tables — are pinned to partition 0; the
+    aggregation-keyed tables are consistent-hashed over all K. With
+    ``shards == 1`` this is behaviourally identical to the plain
+    constructors (one partition owns the whole ring).
+    """
+    from .sharded import (
+        ShardedAggregationsStore,
+        ShardedClerkingJobsStore,
+        ShardRouter,
+    )
+
+    import os
+
+    def _partition(ix: int):
+        if kind == "mem":
+            return (
+                MemAgentsStore(),
+                MemAuthTokensStore(),
+                MemAggregationsStore(),
+                MemClerkingJobsStore(),
+            )
+        if kind == "file":
+            from .filestore import (
+                FileAgentsStore,
+                FileAggregationsStore,
+                FileAuthTokensStore,
+                FileClerkingJobsStore,
+            )
+
+            root = os.path.join(path, f"shard-{ix:02d}")
+            return (
+                FileAgentsStore(os.path.join(root, "agents")),
+                FileAuthTokensStore(os.path.join(root, "auths")),
+                FileAggregationsStore(os.path.join(root, "agg")),
+                FileClerkingJobsStore(os.path.join(root, "jobs")),
+            )
+        if kind == "sqlite":
+            from .sqlstore import (
+                SqliteAgentsStore,
+                SqliteAggregationsStore,
+                SqliteAuthTokensStore,
+                SqliteBackend,
+                SqliteClerkingJobsStore,
+            )
+
+            backend = SqliteBackend(os.path.join(path, f"shard-{ix:02d}.db"))
+            return (
+                SqliteAgentsStore(backend),
+                SqliteAuthTokensStore(backend),
+                SqliteAggregationsStore(backend),
+                SqliteClerkingJobsStore(backend),
+            )
+        raise ValueError(f"unknown sharded store kind: {kind!r}")
+
+    if kind in ("file", "sqlite") and path is None:
+        raise ValueError(f"sharded {kind} store needs a path")
+
+    router = ShardRouter(shards)
+    parts = [_partition(ix) for ix in range(shards)]
+    # each partition's stores get the usual telemetry proxy, so per-op
+    # store metrics stay labelled by backend kind exactly as before
+    aggs = [instrument_store(p[2], kind) for p in parts]
+    jobs = [instrument_store(p[3], kind) for p in parts]
+    return SdaServerService(
+        SdaServer(
+            agents_store=instrument_store(parts[0][0], kind),
+            auth_tokens_store=instrument_store(parts[0][1], kind),
+            aggregation_store=ShardedAggregationsStore(aggs, router),
+            clerking_job_store=ShardedClerkingJobsStore(jobs, router),
+        )
+    )
+
+
 def new_sqlite_server(path) -> SdaServerService:
     """Production sqlite-backed server (the reference's mongo equivalent)."""
     from .sqlstore import (
@@ -94,6 +173,7 @@ __all__ = [
     "new_mem_server",
     "new_file_server",
     "new_sqlite_server",
+    "new_sharded_server",
     "BaseStore",
     "AuthToken",
     "AuthTokensStore",

@@ -1,0 +1,374 @@
+"""Partitioned store seam: K backing store partitions behind the one
+store interface the server core already speaks.
+
+The routable half of the sharded coordination plane (ROADMAP item 2,
+SSNet's service-plane shape): aggregation-keyed state — the hot,
+unbounded tables — is consistent-hashed over K complete backing store
+partitions (mem, file, or sqlite; ``HashRing`` in ``utils/hashring.py``),
+while the small global tables (agents, auth tokens, encryption keys) are
+pinned to shard 0 by the factory (``new_sharded_server``). ``service.py``,
+the snapshot pipeline, paged delivery, and every bulk read work
+unchanged: the sharded classes implement the exact ``AggregationsStore``
+/ ``ClerkingJobsStore`` interfaces and delegate each call to the owning
+partition, so a backend's smarter overrides (sqlite's indexed counts,
+the file store's ranged reads) are still the code that runs.
+
+Routing rules:
+
+- anything keyed by aggregation id hashes to its home partition;
+- clerking jobs ride their ``job.aggregation`` at enqueue, and lookups
+  keyed only by job id or snapshot id consult in-process routing maps
+  recorded at enqueue/snapshot time, falling back to a partition fan-out
+  (first partition that answers) so a fresh process over durable
+  partitions still resolves everything;
+- ``poll_clerking_job`` fans out in shard order — a clerk serves
+  whichever aggregations hashed anywhere;
+- snapshot-scoped result reads are single-partition by construction
+  (every job of a snapshot lives with its aggregation), so the fan-out
+  merge path is exact whenever the map is cold.
+
+Every partition access ticks ``sda_shard_requests_total{shard}`` so the
+split is observable (fan-out ops tick each partition they touch); the
+time-series sampler derives a per-shard rate column from the deltas.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, Optional
+
+from .. import telemetry
+from ..protocol import ServerError
+from ..utils.hashring import HashRing
+from . import stores
+
+
+class ShardRouter:
+    """Shared routing state for one sharded deployment: the ring plus
+    the job-id/snapshot-id maps both sharded stores consult."""
+
+    def __init__(self, shards: int):
+        self.shards = shards
+        self.ring = HashRing(shards)
+        # in-process routing hints only — correctness never depends on
+        # them (every reader has a fan-out fallback), so a fresh process
+        # over durable partitions starts cold and warms as it routes
+        self._snapshot_shard: dict = {}
+        self._job_shard: dict = {}
+
+    def touch(self, ix: int) -> None:
+        if telemetry.enabled():
+            telemetry.counter(
+                "sda_shard_requests_total",
+                "store requests routed per shard (fan-outs tick each "
+                "partition touched)",
+                shard=str(ix),
+            ).inc()
+
+    def aggregation_shard(self, aggregation_id) -> int:
+        return self.ring.shard_for(str(aggregation_id))
+
+    def note_snapshot(self, snapshot_id, ix: int) -> None:
+        self._snapshot_shard[str(snapshot_id)] = ix
+
+    def snapshot_shard(self, snapshot_id) -> Optional[int]:
+        return self._snapshot_shard.get(str(snapshot_id))
+
+    def note_job(self, job_id, ix: int) -> None:
+        self._job_shard[str(job_id)] = ix
+
+    def job_shard(self, job_id) -> Optional[int]:
+        return self._job_shard.get(str(job_id))
+
+
+class ShardedAggregationsStore(stores.AggregationsStore):
+    """K ``AggregationsStore`` partitions routed by aggregation id."""
+
+    def __init__(self, partitions: list, router: ShardRouter):
+        self._parts = partitions
+        self._router = router
+
+    def ping(self) -> None:
+        for part in self._parts:
+            part.ping()
+
+    def _home(self, aggregation_id):
+        ix = self._router.aggregation_shard(aggregation_id)
+        self._router.touch(ix)
+        return self._parts[ix]
+
+    def _snap_home(self, aggregation_id, snapshot_id):
+        """Route by the aggregation AND warm the snapshot map — these
+        calls are the only ones that carry both ids, and the snapshot
+        pipeline issues several of them before the first snapshot-only
+        lookup (mask writes happen before the snapshot record commits)."""
+        ix = self._router.aggregation_shard(aggregation_id)
+        self._router.note_snapshot(snapshot_id, ix)
+        self._router.touch(ix)
+        return self._parts[ix]
+
+    # -- aggregations --------------------------------------------------------
+
+    def list_aggregations(self, filter: Optional[str], recipient) -> list:
+        out: list = []
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            out.extend(part.list_aggregations(filter, recipient))
+        return out
+
+    def create_aggregation(self, aggregation) -> None:
+        self._home(aggregation.id).create_aggregation(aggregation)
+
+    def get_aggregation(self, aggregation_id):
+        return self._home(aggregation_id).get_aggregation(aggregation_id)
+
+    def delete_aggregation(self, aggregation_id) -> None:
+        self._home(aggregation_id).delete_aggregation(aggregation_id)
+
+    def get_committee(self, aggregation_id):
+        return self._home(aggregation_id).get_committee(aggregation_id)
+
+    def create_committee(self, committee) -> None:
+        self._home(committee.aggregation).create_committee(committee)
+
+    # -- participations ------------------------------------------------------
+
+    def create_participation(self, participation) -> None:
+        self._home(participation.aggregation).create_participation(participation)
+
+    def create_participations(self, participations) -> None:
+        """Bulk write grouped by home partition. Atomicity holds within
+        each partition (the backend's contract); a batch spanning
+        aggregations on different shards commits per-shard — the service
+        layer submits per-aggregation batches, so in practice this is
+        one partition's single atomic write."""
+        by_shard: dict = {}
+        for participation in participations:
+            ix = self._router.aggregation_shard(participation.aggregation)
+            by_shard.setdefault(ix, []).append(participation)
+        for ix, group in sorted(by_shard.items()):
+            self._router.touch(ix)
+            self._parts[ix].create_participations(group)
+
+    def count_participations(self, aggregation_id) -> int:
+        return self._home(aggregation_id).count_participations(aggregation_id)
+
+    # -- snapshots -----------------------------------------------------------
+
+    def create_snapshot(self, snapshot) -> None:
+        ix = self._router.aggregation_shard(snapshot.aggregation)
+        self._router.note_snapshot(snapshot.id, ix)
+        self._router.touch(ix)
+        self._parts[ix].create_snapshot(snapshot)
+
+    def list_snapshots(self, aggregation_id) -> list:
+        return self._home(aggregation_id).list_snapshots(aggregation_id)
+
+    def get_snapshot(self, aggregation_id, snapshot_id):
+        return self._snap_home(aggregation_id, snapshot_id).get_snapshot(
+            aggregation_id, snapshot_id
+        )
+
+    def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
+        self._snap_home(aggregation_id, snapshot_id).snapshot_participations(
+            aggregation_id, snapshot_id
+        )
+
+    def iter_snapped_participations(self, aggregation_id, snapshot_id) -> Iterator:
+        return self._snap_home(aggregation_id, snapshot_id).iter_snapped_participations(
+            aggregation_id, snapshot_id
+        )
+
+    def count_participations_snapshot(self, aggregation_id, snapshot_id) -> int:
+        return self._snap_home(
+            aggregation_id, snapshot_id
+        ).count_participations_snapshot(aggregation_id, snapshot_id)
+
+    def validate_snapshot_clerk_jobs(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> None:
+        self._snap_home(aggregation_id, snapshot_id).validate_snapshot_clerk_jobs(
+            aggregation_id, snapshot_id, clerks_number
+        )
+
+    def iter_snapshot_clerk_jobs_data(
+        self, aggregation_id, snapshot_id, clerks_number: int
+    ) -> Iterable:
+        return self._snap_home(
+            aggregation_id, snapshot_id
+        ).iter_snapshot_clerk_jobs_data(aggregation_id, snapshot_id, clerks_number)
+
+    def iter_snapshot_clerk_jobs_chunks(
+        self, aggregation_id, snapshot_id, clerks_number: int, chunk_size: int
+    ) -> Iterable:
+        return self._snap_home(
+            aggregation_id, snapshot_id
+        ).iter_snapshot_clerk_jobs_chunks(
+            aggregation_id, snapshot_id, clerks_number, chunk_size
+        )
+
+    # -- snapshot masks (snapshot-id-keyed) ----------------------------------
+
+    def create_snapshot_mask(self, snapshot_id, mask: list) -> None:
+        ix = self._router.snapshot_shard(snapshot_id)
+        if ix is None:
+            # unreachable through the snapshot pipeline (it routes
+            # several (aggregation, snapshot)-keyed calls first); a
+            # direct write with a cold map has no home to resolve
+            raise ServerError(f"unroutable snapshot mask: {snapshot_id}")
+        self._router.touch(ix)
+        self._parts[ix].create_snapshot_mask(snapshot_id, mask)
+
+    def _mask_read(self, snapshot_id, op, *args):
+        ix = self._router.snapshot_shard(snapshot_id)
+        if ix is not None:
+            self._router.touch(ix)
+            return getattr(self._parts[ix], op)(snapshot_id, *args)
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            out = getattr(part, op)(snapshot_id, *args)
+            if out is not None:
+                self._router.note_snapshot(snapshot_id, ix)
+                return out
+        return None
+
+    def get_snapshot_mask(self, snapshot_id):
+        return self._mask_read(snapshot_id, "get_snapshot_mask")
+
+    def count_snapshot_mask(self, snapshot_id) -> Optional[int]:
+        return self._mask_read(snapshot_id, "count_snapshot_mask")
+
+    def get_snapshot_mask_range(
+        self, snapshot_id, start: int, count: int
+    ) -> Optional[list]:
+        return self._mask_read(snapshot_id, "get_snapshot_mask_range", start, count)
+
+
+class ShardedClerkingJobsStore(stores.ClerkingJobsStore):
+    """K ``ClerkingJobsStore`` partitions; jobs live with their
+    aggregation's shard, polls fan out across all partitions."""
+
+    def __init__(self, partitions: list, router: ShardRouter):
+        self._parts = partitions
+        self._router = router
+
+    def ping(self) -> None:
+        for part in self._parts:
+            part.ping()
+
+    def _enqueue_shard(self, job) -> int:
+        ix = self._router.aggregation_shard(job.aggregation)
+        self._router.note_job(job.id, ix)
+        if job.snapshot is not None:
+            self._router.note_snapshot(job.snapshot, ix)
+        self._router.touch(ix)
+        return ix
+
+    def enqueue_clerking_job(self, job) -> None:
+        self._parts[self._enqueue_shard(job)].enqueue_clerking_job(job)
+
+    def enqueue_clerking_job_chunked(self, job, chunks: Iterable) -> None:
+        self._parts[self._enqueue_shard(job)].enqueue_clerking_job_chunked(job, chunks)
+
+    def poll_clerking_job(self, clerk_id):
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            job = part.poll_clerking_job(clerk_id)
+            if job is not None:
+                self._router.note_job(job.id, ix)
+                return job
+        return None
+
+    def _job_read(self, job_id, op, *args):
+        ix = self._router.job_shard(job_id)
+        if ix is not None:
+            self._router.touch(ix)
+            return getattr(self._parts[ix], op)(*args)
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            out = getattr(part, op)(*args)
+            if out is not None:
+                self._router.note_job(job_id, ix)
+                return out
+        return None
+
+    def get_clerking_job(self, clerk_id, job_id):
+        return self._job_read(job_id, "get_clerking_job", clerk_id, job_id)
+
+    def get_clerking_job_chunk(
+        self, clerk_id, job_id, start: int, count: int
+    ) -> Optional[list]:
+        return self._job_read(
+            job_id, "get_clerking_job_chunk", clerk_id, job_id, start, count
+        )
+
+    def create_clerking_result(self, result) -> None:
+        ix = self._router.job_shard(result.job)
+        if ix is None:
+            # cold map (fresh process): locate the job by owner probe —
+            # the result carries its clerk, and job ids are unique
+            for probe, part in enumerate(self._parts):
+                self._router.touch(probe)
+                if part.get_clerking_job(result.clerk, result.job) is not None:
+                    self._router.note_job(result.job, probe)
+                    ix = probe
+                    break
+        if ix is None:
+            raise ServerError(f"unroutable clerking result: job {result.job}")
+        self._router.touch(ix)
+        self._parts[ix].create_clerking_result(result)
+
+    # -- snapshot-scoped result reads ---------------------------------------
+    # Every job of a snapshot lives on one partition (its aggregation's),
+    # so the cold-map fan-out merges are exact: K-1 partitions contribute
+    # nothing and the canonical sort matches the single-store order.
+
+    def _snap_part(self, snapshot_id):
+        ix = self._router.snapshot_shard(snapshot_id)
+        if ix is None:
+            return None
+        self._router.touch(ix)
+        return self._parts[ix]
+
+    def list_results(self, snapshot_id) -> list:
+        part = self._snap_part(snapshot_id)
+        if part is not None:
+            return part.list_results(snapshot_id)
+        out: list = []
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            out.extend(part.list_results(snapshot_id))
+        return sorted(out, key=str)
+
+    def get_result(self, snapshot_id, job_id):
+        part = self._snap_part(snapshot_id)
+        if part is not None:
+            return part.get_result(snapshot_id, job_id)
+        return self._job_read(job_id, "get_result", snapshot_id, job_id)
+
+    def get_results(self, snapshot_id) -> list:
+        part = self._snap_part(snapshot_id)
+        if part is not None:
+            return part.get_results(snapshot_id)
+        out: list = []
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            out.extend(part.get_results(snapshot_id))
+        return sorted(out, key=lambda r: str(r.job))
+
+    def count_results(self, snapshot_id) -> int:
+        part = self._snap_part(snapshot_id)
+        if part is not None:
+            return part.count_results(snapshot_id)
+        total = 0
+        for ix, part in enumerate(self._parts):
+            self._router.touch(ix)
+            total += part.count_results(snapshot_id)
+        return total
+
+    def get_results_range(self, snapshot_id, start: int, count: int) -> list:
+        part = self._snap_part(snapshot_id)
+        if part is not None:
+            return part.get_results_range(snapshot_id, start, count)
+        if start < 0 or count < 0:
+            return []
+        return self.get_results(snapshot_id)[start : start + count]

@@ -141,6 +141,7 @@ _RATE_COUNTERS = (
     "sda_store_rows_written_total",
     "sda_fault_injections_total",
     "sda_rest_retries_total",
+    "sda_rest_shed_total",
     "sda_slow_requests_total",
 )
 
@@ -274,6 +275,17 @@ class TimeSeriesSampler:
             if name in _RATE_COUNTERS:
                 rates[name] = round(rates.get(name, 0.0) + d / dt, 3)
 
+        # per-shard routing rates (the sharded store's request split);
+        # empty on unsharded deployments, so the column only appears when
+        # there are shards to observe
+        shards: dict = {}
+        for (name, labels), d in counter_deltas.items():
+            if name != "sda_shard_requests_total":
+                continue
+            shard = self._label(labels, "shard")
+            if shard is not None:
+                shards[shard] = round(shards.get(shard, 0.0) + d / dt, 3)
+
         pool_util = None
         for (name, labels), value in cur["gauges"].items():
             if name == "sda_pool_utilization":
@@ -290,6 +302,8 @@ class TimeSeriesSampler:
             },
             "rates": rates,
         }
+        if shards:
+            sample["shards"] = shards
         if pool_util is not None:
             sample["pool_utilization"] = round(pool_util, 4)
 

@@ -71,7 +71,8 @@ _shard_map_probed = False
 
 
 def _probe_shard_map() -> str | None:
-    """None if ``jax.shard_map`` works on the virtual 8-device mesh;
+    """None if ``shard_map`` (via ``sda_tpu.parallel.compat``) works on
+    the virtual 8-device mesh;
     otherwise a short failure string for the skip reason. Probed lazily
     (first collected mesh test) and cached for the session."""
     global _shard_map_failure, _shard_map_probed
@@ -83,9 +84,11 @@ def _probe_shard_map() -> str | None:
         import numpy as np
         from jax.sharding import Mesh, PartitionSpec as P
 
+        from sda_tpu.parallel import compat
+
         devices = np.array(jax.devices()[:8])
         with Mesh(devices, ("x",)):
-            out = jax.shard_map(
+            out = compat.shard_map(
                 lambda v: v + 1,
                 mesh=Mesh(devices, ("x",)),
                 in_specs=P("x"),

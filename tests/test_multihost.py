@@ -119,7 +119,9 @@ def test_fold_mesh_axes_distinct_per_device():
     def per_device(key):
         return jax.random.key_data(fold_mesh_axes(key, mesh))[None]
 
-    keys = jax.shard_map(
+    from sda_tpu.parallel import compat
+
+    keys = compat.shard_map(
         per_device, mesh=mesh, in_specs=P(), out_specs=P(("p", "d")),
         check_vma=False,
     )(jax.random.key(0))
@@ -232,6 +234,11 @@ def test_two_process_distributed_round():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    limitation = "Multiprocess computations aren't implemented on the CPU backend"
+    if any(rc != 0 and limitation in err for rc, _, err in outs):
+        import pytest
+
+        pytest.skip(f"this jax build's CPU backend: {limitation}")
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, f"proc {i} rc={rc}\n{err[-2000:]}"
         assert f"proc {i}/2 OK" in out, out

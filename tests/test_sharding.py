@@ -1,0 +1,349 @@
+"""Sharded coordination plane: equivalence, failover, admission.
+
+The contract under test is the tentpole's: partitioning aggregation
+state over K stores behind N frontends is an *operational* change only —
+every round must reveal byte-identically to the single-store deployment,
+a frontend death must degrade latency (rerouted requests), never
+correctness, and a saturated frontend must shed with 429 + Retry-After
+while its health/metrics probes keep answering.
+
+- the K x store x transport matrix runs one full round per cell through
+  ``new_sharded_server`` and compares the revealed aggregate to a
+  single-store baseline round over the same values;
+- cold-process: a second server instance over the same sqlite partition
+  files (empty routing maps) must resolve everything via fan-out;
+- multi-frontend: ``serve_background_multi`` + the multi-root client,
+  including a frontend killed mid-round;
+- admission: SDA_REST_MAX_INFLIGHT=1 under an injected-latency pileup
+  sheds with 429, exempt probes still answer, the shed counter ticks;
+- the soak artifact's sample series is bounded by the downsampler
+  (newest kept, uniform stride over the rest).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import pathlib
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+DIM = 4
+MODULUS = 433
+VALUES = [[i % 5, i + 1, 2, (3 * i) % 7] for i in range(4)]
+EXPECTED = [sum(v[d] for v in VALUES) % MODULUS for d in range(DIM)]
+
+
+def _open_aggregation(tmp, service, n_clerks=2):
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+
+    recipient, rkey, clerks = new_committee_setup(tmp, service, n_clerks)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="sharding-test",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(
+            modulus=MODULUS, dimension=DIM, seed_bitsize=128
+        ),
+        committee_sharing_scheme=AdditiveSharing(
+            share_count=n_clerks, modulus=MODULUS
+        ),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+    return recipient, clerks, agg
+
+
+def _run_round(tmp, service, values=VALUES) -> list:
+    """One full round over ``service``; returns the revealed ints."""
+    recipient, clerks, agg = _open_aggregation(tmp, service)
+    participant = new_client(tmp / "p", service)
+    participant.upload_agent()
+    participant.upload_participations(
+        participant.new_participations(values, agg.id)
+    )
+    recipient.end_aggregation(agg.id)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    return [int(v) for v in out]
+
+
+# -- hash ring --------------------------------------------------------------
+
+
+def test_hashring_deterministic_balanced():
+    """Placement is a pure function of the key string (never Python's
+    salted hash), preference order starts at the home shard and covers
+    every shard exactly once, and uuid-shaped keys spread reasonably."""
+    from sda_tpu.utils.hashring import HashRing
+
+    a, b = HashRing(4), HashRing(4)
+    keys = [str(uuid.UUID(int=i * 7919)) for i in range(1000)]
+    assert [a.shard_for(k) for k in keys] == [b.shard_for(k) for k in keys]
+
+    counts = [0, 0, 0, 0]
+    for k in keys:
+        pref = a.preference(k)
+        assert sorted(pref) == [0, 1, 2, 3]
+        assert pref[0] == a.shard_for(k)
+        counts[pref[0]] += 1
+    # far from uniform would mean broken point placement; 64 vnodes per
+    # shard keeps every shard within a loose band of the 250 ideal
+    assert min(counts) > 100, counts
+
+    assert HashRing(1).shard_for("anything") == 0
+    with pytest.raises(ValueError):
+        HashRing(0)
+
+
+# -- equivalence matrix -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """The single-store reveal every sharded cell must match."""
+    import tempfile
+
+    from sda_tpu.server import new_mem_server
+
+    with tempfile.TemporaryDirectory() as td:
+        out = _run_round(pathlib.Path(td), new_mem_server())
+    assert out == EXPECTED
+    return out
+
+
+def _sharded_server(kind: str, shards: int, tmp: pathlib.Path):
+    from sda_tpu.server import new_sharded_server
+
+    if kind == "mem":
+        return new_sharded_server("mem", shards)
+    return new_sharded_server(kind, shards, str(tmp / "store"))
+
+
+@pytest.mark.parametrize("kind", ["mem", "file", "sqlite"])
+@pytest.mark.parametrize("shards", [1, 2, 4])
+def test_sharded_round_matches_single_store(kind, shards, tmp_path, baseline):
+    server = _sharded_server(kind, shards, tmp_path)
+    assert _run_round(tmp_path, server) == baseline
+
+
+@pytest.mark.parametrize("kind", ["mem", "sqlite"])
+@pytest.mark.parametrize("shards", [2, 4])
+def test_sharded_round_over_rest(kind, shards, tmp_path, baseline):
+    from sda_tpu.rest import SdaHttpClient, TokenStore, serve_background
+
+    server = _sharded_server(kind, shards, tmp_path)
+    with serve_background(server) as url:
+        client = SdaHttpClient(url, TokenStore(str(tmp_path / "tok")))
+        assert _run_round(tmp_path, client) == baseline
+
+
+def test_sharded_partitions_actually_split(tmp_path):
+    """Sanity against a silent fallback: with K=4 and several
+    aggregations, more than one partition must hold data."""
+    from sda_tpu.server import new_sharded_server
+
+    server = new_sharded_server("sqlite", 4, str(tmp_path / "store"))
+    for tag in "abc":
+        sub = tmp_path / f"round-{tag}"
+        sub.mkdir()
+        assert _run_round(sub, server) == EXPECTED
+    sizes = [
+        (tmp_path / "store" / f"shard-{i:02d}.db").stat().st_size
+        for i in range(4)
+    ]
+    assert all(s > 0 for s in sizes)
+
+
+def test_sharded_cold_process_reveal(tmp_path):
+    """A fresh server over the same partition files starts with EMPTY
+    routing maps; every read must resolve via ring placement or fan-out.
+    This is the restart story: hints are an optimization, never state."""
+    from sda_tpu.server import new_sharded_server
+
+    first = new_sharded_server("sqlite", 3, str(tmp_path / "store"))
+    recipient, clerks, agg = _open_aggregation(tmp_path, first)
+    participant = new_client(tmp_path / "p", first)
+    participant.upload_agent()
+    participant.upload_participations(
+        participant.new_participations(VALUES, agg.id)
+    )
+    recipient.end_aggregation(agg.id)
+    for c in clerks:
+        c.run_chores(-1)
+
+    # reveal through a second instance that never saw the round happen
+    cold = new_sharded_server("sqlite", 3, str(tmp_path / "store"))
+    recipient.service = cold
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    assert [int(v) for v in out] == EXPECTED
+
+
+# -- multi-frontend plane ---------------------------------------------------
+
+
+def test_multi_frontend_round(tmp_path, baseline):
+    from sda_tpu.rest import SdaHttpClient, TokenStore, serve_background_multi
+    from sda_tpu.server import new_sharded_server
+
+    server = new_sharded_server("mem", 2)
+    with serve_background_multi(server, 3) as urls:
+        assert len(set(urls)) == 3
+        client = SdaHttpClient(urls, TokenStore(str(tmp_path / "tok")))
+        assert _run_round(tmp_path, client) == baseline
+
+
+def test_frontend_failover_mid_round(tmp_path):
+    """Kill one of two frontends after ingest; the client must
+    quarantine the dead root, rerun against the survivor, and reveal
+    exactly."""
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+    from sda_tpu.rest.server import listen
+    from sda_tpu.server import new_sharded_server
+
+    server = new_sharded_server("mem", 2)
+    httpds = [listen(("127.0.0.1", 0), server) for _ in range(2)]
+    threads = [
+        threading.Thread(target=h.serve_forever, daemon=True) for h in httpds
+    ]
+    for t in threads:
+        t.start()
+    urls = [
+        f"http://{h.server_address[0]}:{h.server_address[1]}" for h in httpds
+    ]
+    try:
+        client = SdaHttpClient(urls, TokenStore(str(tmp_path / "tok")))
+        recipient, clerks, agg = _open_aggregation(tmp_path, client)
+        participant = new_client(tmp_path / "p", client)
+        participant.upload_agent()
+        participant.upload_participations(
+            participant.new_participations(VALUES, agg.id)
+        )
+
+        # one frontend dies with the snapshot, clerking, and reveal
+        # still to go — every remaining call must fail over
+        httpds[1].shutdown()
+        httpds[1].server_close()
+
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        assert [int(v) for v in out] == EXPECTED
+    finally:
+        for h in httpds:
+            try:
+                h.shutdown()
+                h.server_close()
+            except Exception:
+                pass
+
+
+# -- admission control ------------------------------------------------------
+
+
+def test_admission_sheds_429(tmp_path, monkeypatch):
+    """Under a 1-request ceiling and injected server latency, a 6-wide
+    burst sheds with 429 + Retry-After; /v1/ping and /v1/metrics keep
+    answering (exempt), and sda_rest_shed_total ticks."""
+    import requests
+
+    from sda_tpu.rest import serve_background
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_REST_MAX_INFLIGHT", "1")
+    monkeypatch.setenv("SDA_REST_QUEUE_HIGH_WATER", "0")
+    # every admitted request parks for 300ms, so the burst piles up
+    monkeypatch.setenv("SDA_FAULTS", "server.latency=1.0@0.3:7")
+
+    with serve_background(new_mem_server()) as url:
+        statuses, retry_afters = [], []
+
+        def probe():
+            r = requests.get(f"{url}/v1/aggregations/{uuid.uuid4()}", timeout=10)
+            statuses.append(r.status_code)
+            if r.status_code == 429:
+                retry_afters.append(r.headers.get("Retry-After"))
+
+        threads = [threading.Thread(target=probe) for _ in range(6)]
+        for t in threads:
+            t.start()
+        # while the data plane is saturated, the probes must answer
+        deadline = time.monotonic() + 5
+        while any(t.is_alive() for t in threads):
+            assert requests.get(f"{url}/v1/ping", timeout=10).status_code == 200
+            if time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=10)
+
+        assert statuses.count(429) >= 1, statuses
+        assert any(s != 429 for s in statuses), statuses  # someone got in
+        assert all(ra and float(ra) > 0 for ra in retry_afters)
+        metrics = requests.get(f"{url}/v1/metrics", timeout=10).text
+        assert "sda_rest_shed_total" in metrics
+
+
+def test_admission_off_by_default(monkeypatch):
+    monkeypatch.delenv("SDA_REST_MAX_INFLIGHT", raising=False)
+    from sda_tpu.rest.server import _max_inflight
+
+    assert _max_inflight() == 0
+
+
+# -- soak artifact bound ----------------------------------------------------
+
+
+def _load_soak_module():
+    """Import scripts/load_soak.py without letting its module-level env
+    writes (SDA_TS=0) leak into the test process."""
+    saved = os.environ.get("SDA_TS")
+    spec = importlib.util.spec_from_file_location(
+        "soak_under_test", REPO / "scripts" / "load_soak.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        if saved is None:
+            os.environ.pop("SDA_TS", None)
+        else:
+            os.environ["SDA_TS"] = saved
+    return mod
+
+
+def test_soak_downsample_bound():
+    """The banked series never exceeds the cap, always keeps the newest
+    sample, preserves order, and is a true subsequence of the input."""
+    soak = _load_soak_module()
+    xs = list(range(137))
+    for cap in (1, 2, 3, 10, 50, 136, 137, 200, 0, -1):
+        out = soak.downsample(xs, cap)
+        if cap <= 0 or cap >= len(xs):
+            assert out == xs
+            continue
+        assert len(out) == cap
+        assert out[-1] == xs[-1]
+        assert out == sorted(set(out))  # strictly increasing subsequence
+    assert soak.downsample([], 5) == []
