@@ -1361,6 +1361,207 @@ def measure_shard_scaling(n_participants: int | None = None) -> dict:
     return out
 
 
+def _emit_replication_line(tag: str, value, unit: str, vs_r1, extra: dict) -> None:
+    """One roofline-tagged rider line per replication factor (same
+    interim-line contract as _emit_ingest_line)."""
+    line = {
+        "metric": f"replication_{tag}",
+        "value": value,
+        "unit": unit,
+        "vs_single_home": vs_r1,
+        "trace_id": RUN_TRACE_ID,
+        **extra,
+    }
+    print(json.dumps(line), flush=True)
+
+
+def measure_replication_overhead(n_participants: int | None = None) -> dict:
+    """Replication rider: the SAME ingest round driven in-process against
+    a K=3 sharded sqlite store at R=1 (single-home routing, the PR-12
+    status quo) and at R=2 (quorum writes: every aggregation-keyed row
+    committed to two partitions). Both legs run in this one process over
+    the same store layout, so the A/B isolates the replicated write path
+    itself — fan-out loop, quorum accounting, second sqlite commit — and
+    stays honest on any host (no concurrency is being measured, so the
+    single-core caveat of the shard rider does not gate the bar here;
+    the host width is recorded anyway).
+
+    The timed window is the participation batch commits only (sealing is
+    outside it); each leg finishes its rounds and the revealed aggregate
+    is asserted byte-IDENTICAL between the legs — replication is a
+    durability knob, never a semantics knob. Banked as
+    bench-artifacts/replication-<stamp>.json."""
+    import tempfile
+
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        FullMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.server import new_sharded_server
+
+    n_total = n_participants or int(
+        os.environ.get("SDA_BENCH_REPLICATION_N", "1500")
+    )
+    n_aggs = 6
+    n_per = max(1, n_total // n_aggs)
+    shards = 3
+    dim, modulus = 4, 433
+    out: dict = {
+        "n_participations": n_per * n_aggs,
+        "n_aggregations": n_aggs,
+        "shards": shards,
+        "store": "sqlite",
+        "host_cpus": os.cpu_count(),
+    }
+
+    def leg(replicas: int) -> dict:
+        with tempfile.TemporaryDirectory() as tmp:
+            service = new_sharded_server(
+                "sqlite", shards, str(pathlib.Path(tmp) / "store"),
+                replicas=replicas,
+            )
+            service.shard_router.stop_repair()  # nothing to repair: all up
+            try:
+
+                def mk(name):
+                    ks = Keystore(str(pathlib.Path(tmp) / name))
+                    return SdaClient(SdaClient.new_agent(ks), ks, service)
+
+                recipient = mk("r")
+                recipient.upload_agent()
+                rkey = recipient.new_encryption_key()
+                recipient.upload_encryption_key(rkey)
+                clerks = [mk(f"c{i}") for i in range(3)]
+                for c in clerks:
+                    c.upload_agent()
+                    c.upload_encryption_key(c.new_encryption_key())
+                participant = mk("p")
+                participant.upload_agent()
+
+                aggs, batches = [], []
+                for i in range(n_aggs):
+                    agg = Aggregation(
+                        id=AggregationId.random(),
+                        title="replication-bench",
+                        vector_dimension=dim,
+                        modulus=modulus,
+                        recipient=recipient.agent.id,
+                        recipient_key=rkey,
+                        masking_scheme=FullMasking(modulus=modulus),
+                        committee_sharing_scheme=AdditiveSharing(
+                            share_count=3, modulus=modulus
+                        ),
+                        recipient_encryption_scheme=SodiumEncryptionScheme(),
+                        committee_encryption_scheme=SodiumEncryptionScheme(),
+                    )
+                    recipient.upload_aggregation(agg)
+                    recipient.begin_aggregation(
+                        agg.id, chosen_clerks=[c.agent.id for c in clerks]
+                    )
+                    aggs.append(agg)
+                    # seal outside the timed window: the window measures
+                    # the replicated store commit path, not libsodium
+                    batches.append(
+                        participant.new_participations(
+                            [[1, 2, 3, 4]] * n_per, agg.id
+                        )
+                    )
+
+                t0 = time.perf_counter()
+                for batch in batches:
+                    participant.upload_participations(batch)
+                ingest_s = time.perf_counter() - t0
+
+                for agg in aggs:
+                    recipient.end_aggregation(agg.id)
+                for c in clerks:
+                    c.run_chores(-1)
+                reveals = []
+                for agg in aggs:
+                    reveals.append(
+                        [int(v) for v in
+                         recipient.reveal_aggregation(agg.id).positive().values]
+                    )
+                expected = [(n_per * v) % modulus for v in (1, 2, 3, 4)]
+                if any(r != expected for r in reveals):
+                    raise RuntimeError(
+                        f"replication rider reveal mismatch at R={replicas}"
+                    )
+                return {
+                    "replicas": replicas,
+                    "ingest_s": round(ingest_s, 4),
+                    "ingest_per_s": round(n_per * n_aggs / ingest_s),
+                    "reveal": reveals[0],
+                    "reveals_exact": True,
+                }
+            finally:
+                service.shard_router.stop_repair()
+
+    r1 = leg(1)
+    r2 = leg(2)
+    out["legs"] = {"r1": r1, "r2": r2}
+    # identity: the two legs reveal the same bytes — R is invisible to
+    # the protocol result
+    if r1["reveal"] != r2["reveal"]:
+        raise RuntimeError(
+            f"replication changed the result: R=1 {r1['reveal']} "
+            f"vs R=2 {r2['reveal']}"
+        )
+    out["identical_reveals"] = True
+    overhead = (r1["ingest_per_s"] / max(1, r2["ingest_per_s"]) - 1.0) * 100.0
+    out["r2_ingest_overhead_pct"] = round(overhead, 1)
+    out["multi_core_host"] = (os.cpu_count() or 1) > 1
+    # R=2 writes every aggregation-keyed row twice; wall overhead beyond
+    # ~2.2x (120%) would mean the quorum machinery itself is the cost,
+    # not the second commit
+    if overhead <= 120.0:
+        out["verdict"] = (
+            f"R=2 write-path overhead {out['r2_ingest_overhead_pct']:+.1f}% "
+            "(<= +120% bar for doubled commits); reveals byte-identical"
+        )
+    else:
+        out["verdict"] = (
+            f"R=2 write-path overhead {out['r2_ingest_overhead_pct']:+.1f}% "
+            "above the +120% doubled-commit bar"
+        )
+    _emit_replication_line(
+        "ingest",
+        r2["ingest_per_s"],
+        "participations_per_second",
+        round(r2["ingest_per_s"] / max(1, r1["ingest_per_s"]), 2),
+        {
+            "r1_per_s": r1["ingest_per_s"],
+            "r2_per_s": r2["ingest_per_s"],
+            "r2_overhead_pct": out["r2_ingest_overhead_pct"],
+            "roofline": {
+                "plane": "inproc_store",
+                "bound": "replicated_sqlite_commit",
+                "shards": shards,
+                "n": out["n_participations"],
+            },
+        },
+    )
+
+    payload = {"metric": "replication_overhead", **out}
+    if os.environ.get("SDA_BENCH_ARTIFACTS") == "0":
+        return out
+    here = pathlib.Path(__file__).resolve().parent / "bench-artifacts"
+    try:
+        here.mkdir(exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        (here / f"replication-{stamp}.json").write_text(
+            json.dumps(payload, indent=2)
+        )
+    except OSError as exc:
+        print(f"[bench] replication artifact not written: {exc}", file=sys.stderr)
+    return out
+
+
 def _emit_clerking_line(tag: str, value, unit: str, vs_monolithic, extra: dict) -> None:
     """One roofline-tagged rider line per clerking delivery config (same
     interim-line contract as _emit_ingest_line: the driver reads only the
@@ -3177,6 +3378,11 @@ def main() -> int:
                 _CRYPTO_STATS["shard"] = measure_shard_scaling()
         except Exception as exc:
             print(f"[bench] shard-scaling rider failed: {exc}", file=sys.stderr)
+        try:
+            with stage("replication rider"):
+                _CRYPTO_STATS["replication"] = measure_replication_overhead()
+        except Exception as exc:
+            print(f"[bench] replication rider failed: {exc}", file=sys.stderr)
     # fail fast on an unreachable backend: the wedged-tunnel failure mode
     # (the axon relay can block jax.devices() for hours) would otherwise
     # eat the whole --deadline before the watchdog reports it. The probe

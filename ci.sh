@@ -102,12 +102,15 @@ python examples/secure_sum_fabric.py >/dev/null
 python scripts/crash_soak.py 3
 
 echo "=== ci 5/6: churn-scenario smoke (named scenarios over real REST) ==="
-# three representative cells from the churn harness: clerks vanishing
+# four representative cells from the churn harness: clerks vanishing
 # after the sharing phase (threshold reveal from survivors), a clerk
 # killed mid-chunk then resurrected (sqlite persistence across process
-# death), and a frontend pinned to a one-request admission cap shedding
-# a burst storm with 429s while the round still completes. The banked
-# artifacts must say the reveal was byte-exact, not merely ok.
+# death), a frontend pinned to a one-request admission cap shedding
+# a burst storm with 429s while the round still completes, and a K=3/R=2
+# replicated sqlite plane losing one store shard mid-round (hints queue
+# while it is down, drain after heal, then the repaired victim serves a
+# second exact reveal with its peer wedged). The banked artifacts must
+# say the reveal was byte-exact, not merely ok.
 SCEN_ART="$(mktemp -d)"
 JAX_PLATFORMS=cpu python scripts/scenarios.py \
     --scenarios vanish-after-sharing --stores mem --transports rest \
@@ -118,15 +121,21 @@ JAX_PLATFORMS=cpu python scripts/scenarios.py \
 JAX_PLATFORMS=cpu python scripts/scenarios.py \
     --scenarios saturated-frontend --stores mem --transports rest \
     --artifacts "$SCEN_ART"
+JAX_PLATFORMS=cpu python scripts/scenarios.py \
+    --scenarios kill-shard-mid-round --stores sqlite --transports rest \
+    --artifacts "$SCEN_ART"
 python - "$SCEN_ART" <<'EOF'
 import json, pathlib, sys
 arts = sorted(pathlib.Path(sys.argv[1]).glob("scenario-*.json"))
-assert len(arts) >= 3, f"expected three scenario artifacts, found {arts}"
+assert len(arts) >= 4, f"expected four scenario artifacts, found {arts}"
 for f in arts:
     d = json.loads(f.read_text())
     assert d["ok"] and d["exact"] is True, f"{f.name}: {d}"
 sat = [json.loads(f.read_text()) for f in arts if "saturated" in f.name]
 assert sat and sat[0]["details"]["sheds"] >= 1, "saturated cell never shed"
+rep = [json.loads(f.read_text()) for f in arts if "kill-shard" in f.name]
+assert rep and rep[0]["details"]["hinted_while_down"] >= 1, \
+    "kill-shard cell never exercised hinted handoff"
 print(f"ci: {len(arts)} scenario artifacts banked, all exact")
 EOF
 rm -rf "$SCEN_ART"

@@ -114,6 +114,10 @@ RIDERS = {
     "wire": ("wire-*.json", _metrics_wire),
     "soak": ("soak-*.json", _metrics_soak),
     "shard": ("shard-*.json", _metrics_shard),
+    # pathlib globs match the whole name, so soak-*/replica-soak-* and
+    # shard-*/replication-* never cross-pollinate
+    "replica-soak": ("replica-soak-*.json", _metrics_soak),
+    "replication": ("replication-*.json", _metrics_shard),
 }
 
 

@@ -36,6 +36,15 @@ fails over on connect errors.  The banked ``samples`` series is bounded
 at ``SDA_SOAK_MAX_SAMPLES`` entries (newest kept, rest thinned at a
 uniform stride).
 
+``--shards K --replicas R`` runs the replicated sharded plane instead of
+the plain mem store, and ``--kill-shard M`` wedges the round's HOME
+store shard for the whole body of every M-th round (writes ride the
+surviving replica, the dead shard's writes queue as hints); the shard
+heals when the round completes and the soak waits for hinted handoff to
+drain before moving on.  Every round — killed or not — must still reveal
+byte-exactly; the artifact is banked as ``replica-soak-<stamp>.json`` so
+the replica-soak family rolls up separately from the plain soaks.
+
 The server runs with ``SDA_TS=0`` — the script owns the global sampler
 explicitly so the A/B legs can hold it stopped — and the live
 ``GET /v1/metrics/history`` route is scraped once mid-soak to prove the
@@ -127,7 +136,7 @@ def new_round_aggregation(recipient, rkey, clerks, tag: str):
 
 
 def run_round(ix: int, stack, round_size: int, rate: float | None,
-              submit_services=None) -> dict:
+              submit_services=None, kill_router=None) -> dict:
     """One full round; returns the per-round record. Raises on an
     inexact reveal — a soak that silently aggregates wrong numbers is
     worse than one that stops.
@@ -136,7 +145,13 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
     ``submit_services`` (one extra REST client per worker) the round
     submits concurrently and unpaced instead — the burst shape that can
     actually trip admission control; paced one-at-a-time arrivals never
-    exceed one in-flight request, so they can never shed."""
+    exceed one in-flight request, so they can never shed.
+
+    ``kill_router`` (a ShardRouter, --kill-shard rounds only) wedges the
+    round's home store shard right after the aggregation opens and heals
+    it once the reveal lands — ingest, snapshot, clerking, and reveal
+    all ride the surviving replica while the victim's writes queue as
+    hints."""
     import concurrent.futures
 
     from sda_tpu import telemetry
@@ -146,42 +161,52 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
     expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
 
     t_round0 = time.perf_counter()
-    with telemetry.trace(f"soak-round-{ix}") as trace_id:
-        agg = new_round_aggregation(recipient, rkey, clerks, str(ix))
-        with telemetry.span("ingest.build", rows=round_size):
-            parts = participant.new_participations(values, agg.id)
-        t0 = time.perf_counter()
-        if submit_services:
-            # concurrent burst: each worker drains its slice flat-out on
-            # its own client; 429s surface as client-side paced retries
-            # (sda_rest_retries_total), sheds tick sda_rest_shed_total
-            def drain(worker_ix):
-                service = submit_services[worker_ix]
-                for p in parts[worker_ix::len(submit_services)]:
+    victim = None
+    try:
+        with telemetry.trace(f"soak-round-{ix}") as trace_id:
+            agg = new_round_aggregation(recipient, rkey, clerks, str(ix))
+            if kill_router is not None:
+                victim = kill_router.targets(agg.id)[0]
+                kill_router.wedge(victim)
+            with telemetry.span("ingest.build", rows=round_size):
+                parts = participant.new_participations(values, agg.id)
+            t0 = time.perf_counter()
+            if submit_services:
+                # concurrent burst: each worker drains its slice flat-out
+                # on its own client; 429s surface as client-side paced
+                # retries (sda_rest_retries_total), sheds tick
+                # sda_rest_shed_total
+                def drain(worker_ix):
+                    service = submit_services[worker_ix]
+                    for p in parts[worker_ix::len(submit_services)]:
+                        with telemetry.span("ingest.upload", rows=1):
+                            service.create_participation(participant.agent, p)
+                with concurrent.futures.ThreadPoolExecutor(
+                        max_workers=len(submit_services)) as pool:
+                    for f in [pool.submit(drain, w)
+                              for w in range(len(submit_services))]:
+                        f.result()
+            else:
+                # pinned arrival: one submission per 1/rate seconds,
+                # absolute schedule (sleep to the slot, not after the
+                # previous request) so a slow request doesn't silently
+                # lower the offered rate
+                interarrival = (1.0 / rate) if rate else 0.0
+                for i, p in enumerate(parts):
+                    if interarrival:
+                        delay = t0 + i * interarrival - time.perf_counter()
+                        if delay > 0:
+                            time.sleep(delay)
                     with telemetry.span("ingest.upload", rows=1):
-                        service.create_participation(participant.agent, p)
-            with concurrent.futures.ThreadPoolExecutor(
-                    max_workers=len(submit_services)) as pool:
-                for f in [pool.submit(drain, w)
-                          for w in range(len(submit_services))]:
-                    f.result()
-        else:
-            # pinned arrival: one submission per 1/rate seconds, absolute
-            # schedule (sleep to the slot, not after the previous request)
-            # so a slow request doesn't silently lower the offered rate
-            interarrival = (1.0 / rate) if rate else 0.0
-            for i, p in enumerate(parts):
-                if interarrival:
-                    delay = t0 + i * interarrival - time.perf_counter()
-                    if delay > 0:
-                        time.sleep(delay)
-                with telemetry.span("ingest.upload", rows=1):
-                    participant.upload_participation(p)
-        ingest_s = time.perf_counter() - t0
-        recipient.end_aggregation(agg.id)
-        for c in clerks:
-            c.run_chores(-1)
-        out = recipient.reveal_aggregation(agg.id).positive().values
+                        participant.upload_participation(p)
+            ingest_s = time.perf_counter() - t0
+            recipient.end_aggregation(agg.id)
+            for c in clerks:
+                c.run_chores(-1)
+            out = recipient.reveal_aggregation(agg.id).positive().values
+    finally:
+        if victim is not None:
+            kill_router.heal(victim)
     exact = bool(np.array_equal(np.asarray(out), np.asarray(expected)))
     if not exact:
         raise AssertionError(
@@ -195,6 +220,7 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
         "rate_achieved": round(round_size / ingest_s, 2) if ingest_s > 0 else None,
         "round_s": round(time.perf_counter() - t_round0, 3),
         "exact": exact,
+        "killed_shard": victim,
     }
 
 
@@ -344,8 +370,24 @@ def main() -> int:
                          "instead of paced one-at-a-time — the burst "
                          "shape that exercises admission control "
                          "(default 1 = sequential paced)")
+    ap.add_argument("--shards", type=int, default=1, metavar="K",
+                    help="run the service over K mem store shards "
+                         "instead of the plain mem store (default 1)")
+    ap.add_argument("--replicas", type=int, default=1, metavar="R",
+                    help="replicate aggregation state over the first R "
+                         "shards of the ring preference (default 1)")
+    ap.add_argument("--kill-shard", type=int, default=0, metavar="M",
+                    help="wedge the round's home store shard for the "
+                         "whole body of every M-th round, heal it after "
+                         "the reveal, and wait for hinted handoff to "
+                         "drain (needs --shards > 1 --replicas > 1; "
+                         "0 = off, the default)")
     ap.add_argument("--artifacts", default=str(REPO / "bench-artifacts"))
     args = ap.parse_args()
+
+    if args.kill_shard > 0 and (args.shards < 2 or args.replicas < 2):
+        ap.error("--kill-shard needs --shards >= 2 and --replicas >= 2 "
+                 "(a single-home round cannot survive losing its shard)")
 
     os.environ["SDA_TS_INTERVAL_S"] = str(args.interval)
     if args.max_inflight > 0:
@@ -380,10 +422,19 @@ def main() -> int:
             "max_inflight": args.max_inflight,
             "queue_high_water": args.queue_high_water,
             "submit_workers": args.submit_workers,
+            "shards": args.shards,
+            "replicas": args.replicas,
+            "kill_shard": args.kill_shard,
             "faults": os.environ.get("SDA_FAULTS"),
         },
     }
-    server = new_mem_server()
+    if args.shards > 1:
+        from sda_tpu.server import new_sharded_server
+
+        server = new_sharded_server("mem", args.shards, replicas=args.replicas)
+    else:
+        server = new_mem_server()
+    router = getattr(server, "shard_router", None)
     with contextlib.ExitStack() as ctx:
         if args.frontends > 1:
             roots = ctx.enter_context(
@@ -420,10 +471,34 @@ def main() -> int:
             deadline = time.monotonic() + args.duration
             ix = 0
             while time.monotonic() < deadline:
-                rounds.append(run_round(ix, stack, args.round_size,
-                                        args.rate, submit_services))
+                kill = (
+                    args.kill_shard > 0
+                    and ix % args.kill_shard == args.kill_shard - 1
+                )
+                rounds.append(run_round(
+                    ix, stack, args.round_size, args.rate, submit_services,
+                    kill_router=router if kill else None,
+                ))
+                if kill:
+                    # healed: the repair thread must replay every hint
+                    # before the next round murders a different shard
+                    t0 = time.monotonic()
+                    while router.hint_depth() > 0:
+                        if time.monotonic() - t0 > 30.0:
+                            raise AssertionError(
+                                f"round {ix}: handoff queue stuck at "
+                                f"{router.hint_depth()}"
+                            )
+                        time.sleep(0.05)
+                    rounds[-1]["handoff_drain_s"] = round(
+                        time.monotonic() - t0, 3
+                    )
+                tag = (
+                    f", shard {rounds[-1]['killed_shard']} killed+repaired"
+                    if kill else ""
+                )
                 print(f"[soak] round {ix}: {rounds[-1]['round_s']}s, "
-                      f"arrival {rounds[-1]['rate_achieved']}/s, exact",
+                      f"arrival {rounds[-1]['rate_achieved']}/s, exact{tag}",
                       file=sys.stderr)
                 ix += 1
             # one extra tick so work since the last interval boundary is
@@ -454,13 +529,22 @@ def main() -> int:
         record["readyz"] = {"ready": ready, **readyz}
         record["spans"] = telemetry.spans()
 
+    if router is not None:
+        router.stop_repair()
+
     exact = sum(1 for r in record["rounds"] if r["exact"])
     record["exact_rounds"] = exact
     record["total_rounds"] = len(record["rounds"])
+    record["killed_rounds"] = sum(
+        1 for r in record["rounds"] if r.get("killed_shard") is not None
+    )
 
     artdir = pathlib.Path(args.artifacts)
     artdir.mkdir(parents=True, exist_ok=True)
-    path = artdir / f"soak-{time.strftime('%Y%m%d-%H%M%S')}.json"
+    # the kill-shard axis banks its own artifact family (replica-soak-*)
+    # so bench_compare's plain soak-* rider stays an apples-to-apples set
+    family = "replica-soak" if args.kill_shard > 0 else "soak"
+    path = artdir / f"{family}-{time.strftime('%Y%m%d-%H%M%S')}.json"
     path.write_text(json.dumps(record, indent=1, default=repr))
 
     s = record["summary"]
@@ -480,6 +564,7 @@ def main() -> int:
         and record["healthz"].get("status") == "ok"
         and record["readyz"]["ready"]
         and (record["sampler_ab"] is None or record["sampler_ab"]["ok"])
+        and (args.kill_shard == 0 or record["killed_rounds"] >= 1)
     )
     return 0 if ok else 1
 

@@ -39,6 +39,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="partition aggregation state over K store shards "
         "(file/sqlite paths become per-shard roots under the given path)",
     )
+    parser.add_argument(
+        "--replicas",
+        type=int,
+        default=None,
+        metavar="R",
+        help="replicate each aggregation's state over the first R shards "
+        "of its ring preference (quorum writes + hinted handoff; default "
+        "SDA_SHARD_REPLICAS or 1 — single-home routing). R>1 lets any "
+        "one store shard die mid-round without losing the round.",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
     httpd = sub.add_parser("httpd", help="run the REST server")
     httpd.add_argument("-b", "--bind", default="127.0.0.1:8888", metavar="IP:PORT")
@@ -128,18 +138,25 @@ def main(argv=None) -> int:
         return run_committee_daemon(args)
 
     shards = max(int(args.shards or 1), 1)
+    replicas = args.replicas if args.replicas is None else max(int(args.replicas), 1)
     if shards > 1:
         from ..server import new_sharded_server
 
         if args.file:
-            service = new_sharded_server("file", shards, args.file)
+            service = new_sharded_server("file", shards, args.file, replicas=replicas)
             log.info("using file store at %s over %d shards", args.file, shards)
         elif args.sqlite:
-            service = new_sharded_server("sqlite", shards, args.sqlite)
+            service = new_sharded_server("sqlite", shards, args.sqlite, replicas=replicas)
             log.info("using sqlite store at %s over %d shards", args.sqlite, shards)
         else:
-            service = new_sharded_server("mem", shards)
+            service = new_sharded_server("mem", shards, replicas=replicas)
             log.info("using in-memory store over %d shards", shards)
+        log.info(
+            "replication factor %d (quorum writes + hinted handoff)"
+            if service.shard_router.replicas > 1
+            else "replication factor %d (single-home routing)",
+            service.shard_router.replicas,
+        )
     elif args.file:
         service = new_file_server(args.file)
         log.info("using file store at %s", args.file)

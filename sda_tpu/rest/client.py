@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import re
 import time
 from typing import Optional
@@ -140,6 +141,8 @@ class SdaHttpClient(SdaService):
             self._ring = HashRing(len(self.roots))
         #: root -> monotonic quarantine expiry (transport failures only)
         self._quarantined = {}
+        #: per-client RNG for quarantine full jitter (injectable in tests)
+        self._jitter = random.Random()
         self.token_store = token_store
         self.timeout = timeout
         self.session = requests.Session()
@@ -154,6 +157,18 @@ class SdaHttpClient(SdaService):
         self.session.headers["User-Agent"] = "sda-tpu client"
 
     # -- plumbing -----------------------------------------------------------
+
+    def _quarantine_expiry(self, now: float) -> float:
+        """Quarantine deadline for a frontend that just failed: full
+        jitter over (0, SDA_REST_QUARANTINE_S]. A fixed sit-out would
+        re-synchronize every client that watched the same frontend die —
+        they would all re-probe the recovering process on the same tick,
+        exactly the thundering herd the quarantine exists to prevent.
+        Uniform jitter spreads the re-probes over the whole window; a
+        short draw just means one early scout, not a stampede, because
+        the other clients' deadlines stay spread out."""
+        q = _quarantine_s()
+        return now + (self._jitter.uniform(0.0, q) if q > 0 else 0.0)
 
     def _candidate_roots(self, route_key) -> list:
         """Frontend base URLs in try-order for this request: the key's
@@ -244,6 +259,12 @@ class SdaHttpClient(SdaService):
                         raise requests.ConnectionError(
                             "SDA_FAULTS: injected client-side connection drop"
                         )
+                    elif fault.kind == "reset":
+                        # a client-side reset surfaces the same way a
+                        # server RST mid-body does: a dead connection
+                        raise requests.ConnectionError(
+                            "SDA_FAULTS: injected client-side connection reset"
+                        )
                 resp = self.session.request(
                     method, url, data=data, auth=auth, headers=headers,
                     timeout=self.timeout,
@@ -254,7 +275,7 @@ class SdaHttpClient(SdaService):
                         # this frontend is unreachable: bench it and fall
                         # over to the next one in the key's ring order
                         self._quarantined[candidates[root_ix]] = (
-                            time.monotonic() + _quarantine_s()
+                            self._quarantine_expiry(time.monotonic())
                         )
                         root_ix = (root_ix + 1) % len(candidates)
                         url = candidates[root_ix] + path + query

@@ -207,18 +207,22 @@ class _Response:
     """One fully-assembled HTTP response, plus transport directives:
     ``close`` ends the keep-alive stream after writing, ``truncate``
     (fault injection) declares the full Content-Length but delivers half,
-    ``drop`` (fault injection) kills the connection with no bytes at all."""
+    ``drop`` (fault injection) kills the connection with no bytes at all,
+    ``reset`` (fault injection) delivers half the body then aborts the
+    transport — the mid-response RST a flaky load balancer produces."""
 
-    __slots__ = ("status", "headers", "body", "close", "truncate", "drop")
+    __slots__ = ("status", "headers", "body", "close", "truncate", "drop",
+                 "reset")
 
     def __init__(self, status=500, headers=(), body=b"", close=False,
-                 truncate=False, drop=False):
+                 truncate=False, drop=False, reset=False):
         self.status = status
         self.headers = list(headers)
         self.body = body
         self.close = close
         self.truncate = truncate
         self.drop = drop
+        self.reset = reset
 
 
 class Router:
@@ -275,6 +279,7 @@ class _RequestContext:
         #: frame was read or written) — telemetry label only
         self.wire = "json"
         self._truncate_body = False
+        self._reset_body = False
         self._close = False
         self.response = _Response()
 
@@ -343,6 +348,13 @@ class _RequestContext:
             # Content-Length) and surfaces a transport error
             resp.truncate = True
             resp.close = True
+        if self._reset_body and len(body) > 1:
+            # injected mid-body reset: half the bytes then a transport
+            # abort — unlike truncate's orderly FIN, the client sees the
+            # connection die under it (ConnectionResetError / aborted
+            # read) while already consuming the response
+            resp.reset = True
+            resp.close = True
         self.response = resp
 
     def _send_json_option(self, obj):
@@ -388,6 +400,8 @@ class _RequestContext:
                 return
             elif fault.kind == "truncate":
                 self._truncate_body = True
+            elif fault.kind == "reset":
+                self._reset_body = True
         if telemetry.enabled():
             # adopt the client's trace id (or mint one) for this handler;
             # echoed back by _send alongside the request id
@@ -1023,13 +1037,18 @@ class SdaRestServer:
         if response.close:
             head.append("Connection: close")
         payload = ("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
-        if response.truncate and len(body) > 1:
+        if (response.truncate or response.reset) and len(body) > 1:
             payload += body[: len(body) // 2]
             response.close = True
         else:
             payload += body
         writer.write(payload)
         await writer.drain()
+        if response.reset and len(body) > 1:
+            # slam the connection mid-body: abort discards the FIN
+            # handshake, so the peer's read fails hard instead of seeing
+            # a short-but-orderly body
+            writer.transport.abort()
 
 
 # -- module API (shape-compatible with the ThreadingHTTPServer era) ---------

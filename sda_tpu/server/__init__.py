@@ -67,7 +67,9 @@ def new_file_server(path) -> SdaServerService:
     )
 
 
-def new_sharded_server(kind: str, shards: int, path=None) -> SdaServerService:
+def new_sharded_server(
+    kind: str, shards: int, path=None, replicas=None
+) -> SdaServerService:
     """Server over K store partitions routed by aggregation id.
 
     ``kind`` picks the backend for every partition (``mem`` / ``file`` /
@@ -77,6 +79,14 @@ def new_sharded_server(kind: str, shards: int, path=None) -> SdaServerService:
     aggregation-keyed tables are consistent-hashed over all K. With
     ``shards == 1`` this is behaviourally identical to the plain
     constructors (one partition owns the whole ring).
+
+    ``replicas`` (default: ``SDA_SHARD_REPLICAS``, 1) writes each
+    aggregation's state to the first R shards of its ring preference
+    with quorum + hinted handoff, so any one partition can die mid-round
+    without losing the round (see ``server/sharded.py``). R > 1 starts
+    the background handoff-repair thread; the router is exposed as
+    ``service.shard_router`` for operability (wedge/heal hooks, hint
+    depth, deterministic drains in tests).
     """
     from .sharded import (
         ShardedAggregationsStore,
@@ -129,14 +139,16 @@ def new_sharded_server(kind: str, shards: int, path=None) -> SdaServerService:
 
     if kind in ("file", "sqlite") and path is None:
         raise ValueError(f"sharded {kind} store needs a path")
+    if replicas is None:
+        replicas = int(os.environ.get("SDA_SHARD_REPLICAS", "1") or 1)
 
-    router = ShardRouter(shards)
+    router = ShardRouter(shards, replicas=replicas, root=path)
     parts = [_partition(ix) for ix in range(shards)]
     # each partition's stores get the usual telemetry proxy, so per-op
     # store metrics stay labelled by backend kind exactly as before
     aggs = [instrument_store(p[2], kind) for p in parts]
     jobs = [instrument_store(p[3], kind) for p in parts]
-    return SdaServerService(
+    service = SdaServerService(
         SdaServer(
             agents_store=instrument_store(parts[0][0], kind),
             auth_tokens_store=instrument_store(parts[0][1], kind),
@@ -144,6 +156,10 @@ def new_sharded_server(kind: str, shards: int, path=None) -> SdaServerService:
             clerking_job_store=ShardedClerkingJobsStore(jobs, router),
         )
     )
+    service.shard_router = router
+    if router.replicas > 1:
+        router.start_repair()
+    return service
 
 
 def new_sqlite_server(path) -> SdaServerService:

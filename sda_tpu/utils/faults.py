@@ -16,6 +16,8 @@ Spec grammar (``SDA_FAULTS=<spec>:<seed>``)::
            | "e503"     — answer 503; param = Retry-After seconds (0.05)
            | "latency"  — stall before handling; param = seconds (0.05)
            | "truncate" — declare the full Content-Length but send half
+           | "reset"    — send half the body then abort the connection
+                          (the mid-response-body RST flaky LBs produce)
     rate  := probability in [0, 1] that a request draws this fault
     seed  := integer (default 0)
 
@@ -48,11 +50,17 @@ from .. import telemetry
 
 SPEC_ENV = "SDA_FAULTS"
 
-KINDS = ("drop", "e503", "latency", "truncate")
+KINDS = ("drop", "e503", "latency", "truncate", "reset")
 
 #: default per-kind parameter (seconds: Retry-After for e503, stall for
-#: latency; drop/truncate take no parameter)
-_DEFAULT_PARAM = {"drop": 0.0, "e503": 0.05, "latency": 0.05, "truncate": 0.0}
+#: latency; drop/truncate/reset take no parameter)
+_DEFAULT_PARAM = {
+    "drop": 0.0,
+    "e503": 0.05,
+    "latency": 0.05,
+    "truncate": 0.0,
+    "reset": 0.0,
+}
 
 
 @dataclass(frozen=True)

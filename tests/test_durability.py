@@ -77,6 +77,45 @@ def test_server_restart_mid_protocol(tmp_path, backend):
 
 
 @pytest.mark.parametrize("backend", ["file", "sqlite"])
+@pytest.mark.parametrize("replicas", [1, 2])
+def test_sharded_server_restart_mid_protocol(tmp_path, backend, replicas):
+    """The restart story over a K=3 partitioned store, single-home (R=1)
+    and replicated (R=2): a cold ``new_sharded_server`` over the same
+    partition roots starts with empty routing-hint maps and (at R>1) an
+    empty handoff queue, so every read after the reboot must resolve via
+    ring placement or fan-out — and the reveal stays exact."""
+    from sda_tpu.server import new_sharded_server
+
+    root = str(tmp_path / "store")
+    service = new_sharded_server(backend, 3, root, replicas=replicas)
+    recipient, clerks, agg = _run_protocol_to_snapshot(
+        tmp_path, service, "sharded-durable"
+    )
+
+    # --- crash mid-round: snapshot + queued jobs exist, no results yet
+    service.shard_router.stop_repair()
+    del service
+    service2 = new_sharded_server(backend, 3, root, replicas=replicas)
+    assert service2.shard_router.replicas == replicas
+    try:
+
+        def rebind(client):
+            return SdaClient(client.agent, client.crypto.keystore, service2)
+
+        recipient2 = rebind(recipient)
+        for clerk in [recipient2] + [rebind(c) for c in clerks]:
+            clerk.run_chores(-1)  # queued jobs survived the restart
+
+        out = recipient2.reveal_aggregation(agg.id)
+        np.testing.assert_array_equal(out.positive().values, [2, 4, 6, 8])
+        # a replicated reboot never needed handoff: every partition was
+        # healthy, so the queue stays empty (writes hit all R homes)
+        assert service2.shard_router.hint_depth() == 0
+    finally:
+        service2.shard_router.stop_repair()
+
+
+@pytest.mark.parametrize("backend", ["file", "sqlite"])
 def test_clerk_crash_before_result_repolls_same_job(tmp_path, backend):
     """Protocol-level elastic recovery (SURVEY §5 item 4): a job stays
     queued until a result is posted, so a clerk that polled a job and

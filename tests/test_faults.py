@@ -264,6 +264,155 @@ def test_truncated_body_is_retried_transport_failure(tmp_path, monkeypatch):
         assert client.ping().running  # plane off again: healthy
 
 
+# -- the reset kind: mid-body RST, client and server sides ------------------
+
+
+def test_reset_spec_and_determinism():
+    """``reset`` parses on both sides, defaults to no parameter, and its
+    decision sequence is a pure function of (seed, index) like every
+    other kind."""
+    rules, seed = parse_spec("reset=0.3,client.reset=0.2:13")
+    assert [(r.side, r.kind, r.param) for r in rules] == [
+        ("server", "reset", 0.0),
+        ("client", "reset", 0.0),
+    ]
+    plane_a = FaultPlane(rules, seed, "server")
+    plane_b = FaultPlane(rules, seed, "server")
+    seq = [plane_a.decide(i) for i in range(300)]
+    assert seq == [plane_b.decide(i) for i in range(300)]
+    kinds = {f.kind for f in seq if f is not None}
+    assert kinds == {"reset"}
+    # ~30% of draws reset (the rate is honored over the long run)
+    n_reset = sum(1 for f in seq if f is not None)
+    assert 0.2 < n_reset / 300 < 0.4
+
+
+def test_server_reset_mid_body_is_retried_transport_failure(tmp_path, monkeypatch):
+    """A server that sends headers + half the body then aborts the
+    connection (RST, not FIN) must surface as a retryable transport
+    failure — never a half-decoded response. At rate 1.0 the budget
+    exhausts into SdaError; with the plane lifted the same client and
+    connection pool recover."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_REST_RETRIES", "2")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.005")
+    with serve_background(new_mem_server()) as url:
+        client = SdaHttpClient(url, TokenStore(str(tmp_path)))
+        assert client.ping().running  # faults off: healthy
+        monkeypatch.setenv("SDA_FAULTS", "reset=1.0:3")
+        with pytest.raises(SdaError, match="transport failure"):
+            client.ping()
+        monkeypatch.delenv("SDA_FAULTS")
+        assert client.ping().running  # plane off again: healthy
+
+
+def test_reset_storm_retries_through(tmp_path, monkeypatch):
+    """At a sub-1.0 reset rate the deterministic sequence leaves gaps;
+    the retry loop must push a request through one of them and count
+    every burned attempt."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_REST_RETRIES", "8")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.005")
+    monkeypatch.setenv("SDA_TELEMETRY", "1")
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with serve_background(new_mem_server()) as url:
+            client = SdaHttpClient(url, TokenStore(str(tmp_path)))
+            monkeypatch.setenv("SDA_FAULTS", "reset=0.5,client.reset=0.2:3")
+            for _ in range(5):
+                assert client.ping().running
+            counters = telemetry.snapshot(include_spans=0)["counters"]
+            injections = {
+                (c["labels"].get("side"), c["labels"].get("kind")): c["value"]
+                for c in counters
+                if c["name"] == "sda_fault_injections_total"
+            }
+            # both sides actually injected resets (seed 3 guarantees it
+            # deterministically), and every one was retried through
+            assert injections.get(("server", "reset"), 0) > 0, counters
+            assert injections.get(("client", "reset"), 0) > 0, counters
+            retries = sum(
+                c["value"] for c in counters if c["name"] == "sda_rest_retries_total"
+            )
+            assert retries > 0, counters
+    finally:
+        telemetry.reset()
+
+
+# -- quarantine full jitter -------------------------------------------------
+
+
+def test_quarantine_expiry_full_jitter(tmp_path, monkeypatch):
+    """Frontend-quarantine deadlines must be de-synchronized: if every
+    client that watched a frontend die re-probed exactly
+    SDA_REST_QUARANTINE_S later, they would all stampede the recovering
+    process on the same tick. Full jitter draws the sit-out uniformly
+    over (0, Q], so deadlines spread across the whole window."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.tokenstore import TokenStore
+
+    monkeypatch.setenv("SDA_REST_QUARANTINE_S", "3.0")
+    client = SdaHttpClient("http://127.0.0.1:9", TokenStore(str(tmp_path)))
+    now = 1000.0
+    draws = [client._quarantine_expiry(now) - now for _ in range(200)]
+    # bounded by the configured window, never negative
+    assert all(0.0 <= d <= 3.0 for d in draws)
+    # de-synchronized: the draws genuinely spread over the window
+    # instead of clustering at the fixed deadline
+    assert len(set(draws)) > 190
+    assert max(draws) - min(draws) > 1.0
+    assert min(draws) < 1.0 and max(draws) > 2.0
+    # a second client (same env, same instant) lands on different ticks
+    other = SdaHttpClient("http://127.0.0.1:9", TokenStore(str(tmp_path)))
+    assert [other._quarantine_expiry(now) for _ in range(20)] != [
+        client._quarantine_expiry(now) for _ in range(20)
+    ]
+    # quarantine disabled: expiry is "now", no sit-out at all
+    monkeypatch.setenv("SDA_REST_QUARANTINE_S", "0")
+    assert client._quarantine_expiry(now) == now
+
+
+def test_transport_failure_quarantine_is_jittered(tmp_path, monkeypatch):
+    """End to end: a multi-root client that benches a dead frontend must
+    record a jittered deadline (within the window, not pinned to the
+    fixed Q seconds) and still fail over to the survivor."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_REST_RETRIES", "4")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.001")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.005")
+    monkeypatch.setenv("SDA_REST_QUARANTINE_S", "30.0")
+    import random
+
+    with serve_background(new_mem_server()) as url:
+        # root 0 is a dead port; root 1 is the live server
+        dead = "http://127.0.0.1:9"
+        client = SdaHttpClient([dead, url], TokenStore(str(tmp_path)))
+        client._jitter = random.Random(7)  # injectable, per the client
+        t0 = time.monotonic()
+        assert client.ping().running  # failed over to the survivor
+        sit_out = client._quarantined[dead] - t0
+        assert 0.0 <= sit_out <= 30.0 + 1.0
+        # the deadline is the seeded full-jitter draw (± request time),
+        # not the fixed 30s a jitterless quarantine would record
+        expected = random.Random(7).uniform(0.0, 30.0)
+        assert abs(sit_out - expected) < 2.0
+
+
 # -- the acceptance bar: a faulted masked round completes exactly -----------
 
 

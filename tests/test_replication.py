@@ -1,0 +1,397 @@
+"""Replicated shard plane: quorum writes, hinted handoff, read repair.
+
+The contract under test is PR 13's tentpole: with ``replicas=R`` every
+aggregation's state lives on the first R shards of its ring preference,
+writes need a quorum of durable intents (real acks + queued hints) with
+at least one real ack, and losing ANY one store shard mid-round must
+never lose the round — the reveal stays byte-exact off the survivors
+while the dead shard's writes wait in the handoff queue and are replayed
+when it returns. R=1 must stay byte-identical to the single-home plane
+(test_sharding.py is the equivalence witness; here we pin the routing).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup
+
+DIM = 4
+MODULUS = 433
+VALUES = [[i % 5, i + 1, 2, (3 * i) % 7] for i in range(4)]
+EXPECTED = [sum(v[d] for v in VALUES) % MODULUS for d in range(DIM)]
+
+
+def _open_aggregation(tmp, service, n_clerks=2):
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+
+    recipient, rkey, clerks = new_committee_setup(tmp, service, n_clerks)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="replication-test",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(
+            modulus=MODULUS, dimension=DIM, seed_bitsize=128
+        ),
+        committee_sharing_scheme=AdditiveSharing(
+            share_count=n_clerks, modulus=MODULUS
+        ),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+    return recipient, clerks, agg
+
+
+def _ingest(tmp, service, agg, values=VALUES):
+    participant = new_client(tmp / "p", service)
+    participant.upload_agent()
+    participant.upload_participations(
+        participant.new_participations(values, agg.id)
+    )
+
+
+def _replicated_server(kind, shards, tmp, replicas=2):
+    from sda_tpu.server import new_sharded_server
+
+    if kind == "mem":
+        service = new_sharded_server("mem", shards, replicas=replicas)
+    else:
+        service = new_sharded_server(
+            kind, shards, str(tmp / "store"), replicas=replicas
+        )
+    # deterministic stepping: tests drain the handoff queue explicitly
+    service.shard_router.stop_repair()
+    return service
+
+
+# -- routing + defaults -----------------------------------------------------
+
+
+def test_replica_targets_and_defaults(tmp_path, monkeypatch):
+    """R defaults to 1 (single-home: one-element target sets, exactly
+    PR 12's routing); SDA_SHARD_REPLICAS and the explicit argument widen
+    the target set to a prefix of the ring preference, clamped to K."""
+    from sda_tpu.server import new_sharded_server
+
+    s1 = new_sharded_server("mem", 3)
+    router = s1.shard_router
+    assert router.replicas == 1
+    for key in ("a", "b", "c", "d"):
+        assert router.targets(key) == (router.aggregation_shard(key),)
+
+    monkeypatch.setenv("SDA_SHARD_REPLICAS", "2")
+    s2 = new_sharded_server("mem", 3)
+    try:
+        assert s2.shard_router.replicas == 2
+        for key in ("a", "b", "c", "d"):
+            t = s2.shard_router.targets(key)
+            assert len(t) == 2 and len(set(t)) == 2
+            assert t == tuple(s2.shard_router.ring.preference(key)[:2])
+            assert t[0] == s2.shard_router.aggregation_shard(key)
+    finally:
+        s2.shard_router.stop_repair()
+
+    # clamped to the shard count; silly values never explode the fan-out
+    s3 = new_sharded_server("mem", 2, replicas=9)
+    try:
+        assert s3.shard_router.replicas == 2
+    finally:
+        s3.shard_router.stop_repair()
+
+
+# -- equivalence: a healthy replicated round reveals exactly ----------------
+
+
+@pytest.mark.parametrize("kind", ["mem", "file", "sqlite"])
+def test_replicated_round_matches_baseline(kind, tmp_path):
+    service = _replicated_server(kind, 3, tmp_path, replicas=2)
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+    _ingest(tmp_path, service, agg)
+    recipient.end_aggregation(agg.id)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    assert [int(v) for v in out] == EXPECTED
+    # every partition healthy: nothing was ever hinted
+    assert service.shard_router.hint_depth() == 0
+
+
+# -- the acceptance bar: lose the HOME shard mid-round ----------------------
+
+
+@pytest.mark.parametrize("kind", ["mem", "file", "sqlite"])
+def test_lose_home_shard_mid_round(kind, tmp_path):
+    """Wedge the aggregation's home shard after ingest: the snapshot,
+    clerking, and reveal must all complete byte-exactly off the
+    surviving replica, with the victim's writes queued as hints; healing
+    + one drain replays them, after which the REPAIRED victim can serve
+    the whole tail of the round with the survivor wedged instead."""
+    service = _replicated_server(kind, 3, tmp_path, replicas=2)
+    router = service.shard_router
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+    _ingest(tmp_path, service, agg)
+
+    home, survivor = router.targets(agg.id)
+    router.wedge(home)
+    try:
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        assert [int(v) for v in out] == EXPECTED
+        # the round's post-wedge writes are all queued for the victim
+        assert router.hint_depth() > 0
+        # still down: a drain applies nothing and keeps every hint
+        before = router.hint_depth()
+        assert router.drain_hints_once() == 0
+        assert router.hint_depth() == before
+    finally:
+        router.heal(home)
+
+    # healed: one pass replays everything, in order
+    assert router.drain_hints_once() == before
+    assert router.hint_depth() == 0
+
+    # the proof the victim was really repaired: kill the shard that
+    # carried the round and reveal again off the replayed copy
+    router.wedge(survivor)
+    try:
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        assert [int(v) for v in out] == EXPECTED
+    finally:
+        router.heal(survivor)
+
+
+def test_lose_secondary_shard_mid_round(tmp_path):
+    """Same round, but the non-home replica dies instead — 'lose ANY
+    one shard' means both positions in the target set."""
+    service = _replicated_server("sqlite", 3, tmp_path, replicas=2)
+    router = service.shard_router
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+    _ingest(tmp_path, service, agg)
+
+    home, secondary = router.targets(agg.id)
+    router.wedge(secondary)
+    try:
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        assert [int(v) for v in out] == EXPECTED
+        assert router.hint_depth() > 0
+    finally:
+        router.heal(secondary)
+    assert router.drain_hints_once() > 0
+    assert router.hint_depth() == 0
+
+    router.wedge(home)
+    try:
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        assert [int(v) for v in out] == EXPECTED
+    finally:
+        router.heal(home)
+
+
+def test_background_repair_thread_drains(tmp_path):
+    """The factory's repair thread (R > 1) replays hints without any
+    explicit drain call once the shard heals."""
+    import time
+
+    from sda_tpu.server import new_sharded_server
+
+    service = new_sharded_server(
+        "mem", 3, replicas=2
+    )  # repair thread running (default 0.5s interval)
+    router = service.shard_router
+    try:
+        recipient, clerks, agg = _open_aggregation(tmp_path, service)
+        _ingest(tmp_path, service, agg)
+        home = router.targets(agg.id)[0]
+        router.wedge(home)
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        assert router.hint_depth() > 0
+        router.heal(home)
+        deadline = time.monotonic() + 10.0
+        while router.hint_depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.hint_depth() == 0
+    finally:
+        router.stop_repair()
+
+
+# -- quorum + fault-hook semantics ------------------------------------------
+
+
+def test_both_replicas_down_fails_the_write(tmp_path):
+    """No durable home at all: the quorum rule (>= 1 real ack) must
+    reject the write loudly instead of pretending."""
+    from sda_tpu.server.sharded import ShardDownError
+
+    service = _replicated_server("mem", 3, tmp_path, replicas=2)
+    router = service.shard_router
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+
+    for ix in router.targets(agg.id):
+        router.wedge(ix)
+    try:
+        with pytest.raises(ShardDownError):
+            _ingest(tmp_path, service, agg)
+    finally:
+        for ix in router.targets(agg.id):
+            router.heal(ix)
+    # healed again: the round completes normally end to end
+    _ingest(tmp_path / "retry", service, agg)
+    recipient.end_aggregation(agg.id)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    assert [int(v) for v in out] == EXPECTED
+
+
+def test_logical_rejections_are_never_hinted(tmp_path):
+    """SdaError subclasses are deterministic logical verdicts (conflict,
+    missing parent), not transport failures: they propagate immediately
+    and must not pollute the handoff queue."""
+    from sda_tpu.protocol import InvalidRequestError
+    from sda_tpu.protocol.errors import SdaError
+
+    service = _replicated_server("mem", 3, tmp_path, replicas=2)
+    router = service.shard_router
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+
+    # conflicting create (same id, different payload): every replica
+    # rejects identically — an identical replay would be absorbed, but a
+    # mutated one is a hard conflict
+    import dataclasses
+
+    clash = dataclasses.replace(agg, title="someone else's round")
+    with pytest.raises(SdaError):
+        service.server.aggregation_store.create_aggregation(clash)
+    # participation pointed at an aggregation that exists nowhere: every
+    # replica raises the same "no aggregation" verdict
+    from sda_tpu.protocol import AggregationId
+
+    participant = new_client(tmp_path / "p", service)
+    participant.upload_agent()
+    [p] = participant.new_participations(VALUES[:1], agg.id)
+    ghost_p = dataclasses.replace(p, aggregation=AggregationId.random())
+    with pytest.raises(InvalidRequestError):
+        service.server.aggregation_store.create_participation(ghost_p)
+    assert router.hint_depth() == 0
+
+
+def test_marker_file_wedges_across_process_boundary(tmp_path):
+    """The ``shard-NN.down`` marker is the cross-process fault hook the
+    kill-shard scenario and the soak use against a live ``sdad``: its
+    presence wedges the shard exactly like the in-process hook."""
+    from sda_tpu.server.sharded import ShardRouter
+
+    service = _replicated_server("sqlite", 3, tmp_path, replicas=2)
+    router = service.shard_router
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+    _ingest(tmp_path, service, agg)
+
+    home = router.targets(agg.id)[0]
+    marker = pathlib.Path(ShardRouter.down_marker(router.root, home))
+    marker.touch()
+    try:
+        assert router.shard_down(home)
+        recipient.end_aggregation(agg.id)
+        for c in clerks:
+            c.run_chores(-1)
+        out = recipient.reveal_aggregation(agg.id).positive().values
+        assert [int(v) for v in out] == EXPECTED
+        assert router.hint_depth() > 0
+    finally:
+        marker.unlink()
+    assert not router.shard_down(home)
+    assert router.drain_hints_once() > 0
+
+
+# -- read repair ------------------------------------------------------------
+
+
+def test_read_repair_restores_lost_record(tmp_path):
+    """A replica missing a record it should hold (here: surgically
+    deleted from the victim partition) is healed by the next read that
+    finds the record on a later replica, and the repair is counted."""
+    from sda_tpu import telemetry
+    from sda_tpu.server.sqlstore import SqliteAggregationsStore, SqliteBackend
+
+    service = _replicated_server("sqlite", 3, tmp_path, replicas=2)
+    router = service.shard_router
+    recipient, clerks, agg = _open_aggregation(tmp_path, service)
+    home = router.targets(agg.id)[0]
+
+    # surgically lose the aggregation row on the home replica
+    part = SqliteAggregationsStore(
+        SqliteBackend(str(tmp_path / "store" / f"shard-{home:02d}.db"))
+    )
+    assert part.get_aggregation(agg.id) is not None
+    part.delete_aggregation(agg.id)
+    assert part.get_aggregation(agg.id) is None
+
+    was_enabled = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        # a read through the service walks home (miss) -> survivor (hit)
+        # and writes the record back to the home replica
+        got = service.server.aggregation_store.get_aggregation(agg.id)
+        assert got is not None and got.id == agg.id
+        counters = telemetry.snapshot(include_spans=0)["counters"]
+        repairs = sum(
+            c["value"]
+            for c in counters
+            if c["name"] == "sda_shard_read_repairs_total"
+        )
+        assert repairs >= 1, counters
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(was_enabled)
+    assert part.get_aggregation(agg.id) is not None
+
+
+# -- REST transport: the same failure, one layer up -------------------------
+
+
+def test_lose_home_shard_mid_round_over_rest(tmp_path):
+    """The wedge exercised through the full REST stack: the client only
+    ever sees clean responses while the store layer rides the surviving
+    replica."""
+    from sda_tpu.rest import SdaHttpClient, TokenStore, serve_background
+
+    service = _replicated_server("sqlite", 3, tmp_path, replicas=2)
+    router = service.shard_router
+    with serve_background(service) as url:
+        client = SdaHttpClient(url, TokenStore(str(tmp_path / "tok")))
+        recipient, clerks, agg = _open_aggregation(tmp_path, client)
+        _ingest(tmp_path, client, agg)
+        home = router.targets(agg.id)[0]
+        router.wedge(home)
+        try:
+            recipient.end_aggregation(agg.id)
+            for c in clerks:
+                c.run_chores(-1)
+            out = recipient.reveal_aggregation(agg.id).positive().values
+            assert [int(v) for v in out] == EXPECTED
+            assert router.hint_depth() > 0
+        finally:
+            router.heal(home)
+        assert router.drain_hints_once() > 0
+        assert router.hint_depth() == 0
