@@ -106,11 +106,14 @@ echo "=== ci 5/6: churn-scenario smoke (named scenarios over real REST) ==="
 # after the sharing phase (threshold reveal from survivors), a clerk
 # killed mid-chunk then resurrected (sqlite persistence across process
 # death), a frontend pinned to a one-request admission cap shedding
-# a burst storm with 429s while the round still completes, and a K=3/R=2
+# a burst storm with 429s while the round still completes, a K=3/R=2
 # replicated sqlite plane losing one store shard mid-round (hints queue
 # while it is down, drain after heal, then the repaired victim serves a
-# second exact reveal with its peer wedged). The banked artifacts must
-# say the reveal was byte-exact, not merely ok.
+# second exact reveal with its peer wedged), and the two hierarchical
+# cells: a sub-committee losing a clerk (threshold reveal one tier down,
+# root still byte-exact) and an entire sub-cohort vanishing (lenient
+# driver skips it, root reveals the survivors' exact sum). The banked
+# artifacts must say the reveal was byte-exact, not merely ok.
 SCEN_ART="$(mktemp -d)"
 JAX_PLATFORMS=cpu python scripts/scenarios.py \
     --scenarios vanish-after-sharing --stores mem --transports rest \
@@ -124,13 +127,20 @@ JAX_PLATFORMS=cpu python scripts/scenarios.py \
 JAX_PLATFORMS=cpu python scripts/scenarios.py \
     --scenarios kill-shard-mid-round --stores sqlite --transports rest \
     --artifacts "$SCEN_ART"
+JAX_PLATFORMS=cpu python scripts/scenarios.py \
+    --scenarios sub-committee-clerk-killed,sub-cohort-vanishes \
+    --stores sqlite --transports rest --artifacts "$SCEN_ART"
 python - "$SCEN_ART" <<'EOF'
 import json, pathlib, sys
 arts = sorted(pathlib.Path(sys.argv[1]).glob("scenario-*.json"))
-assert len(arts) >= 4, f"expected four scenario artifacts, found {arts}"
+assert len(arts) >= 6, f"expected six scenario artifacts, found {arts}"
 for f in arts:
     d = json.loads(f.read_text())
     assert d["ok"] and d["exact"] is True, f"{f.name}: {d}"
+tiered = [json.loads(f.read_text()) for f in arts
+          if "sub-committee" in f.name or "sub-cohort" in f.name]
+assert len(tiered) >= 2, "hierarchical scenario cells missing"
+assert all(d["exact"] is True for d in tiered)
 sat = [json.loads(f.read_text()) for f in arts if "saturated" in f.name]
 assert sat and sat[0]["details"]["sheds"] >= 1, "saturated cell never shed"
 rep = [json.loads(f.read_text()) for f in arts if "kill-shard" in f.name]

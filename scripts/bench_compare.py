@@ -96,6 +96,20 @@ def _metrics_shard(d: dict) -> dict:
     return out
 
 
+def _metrics_tier(d: dict) -> dict:
+    """tier-*: clerked inputs per clerk-second, one metric per fan-out
+    config (flat baseline included — a flat-path regression must not hide
+    behind the tiered columns)."""
+    out = {}
+    configs = d.get("configs") if isinstance(d.get("configs"), dict) else {}
+    for tag, cfg in configs.items():
+        if isinstance(cfg, dict) and isinstance(
+            cfg.get("inputs_per_clerk_s"), (int, float)
+        ):
+            out[f"{tag}_inputs_per_clerk_s"] = float(cfg["inputs_per_clerk_s"])
+    return out
+
+
 def _metrics_soak(d: dict) -> dict:
     out = {}
     summary = d.get("summary") if isinstance(d.get("summary"), dict) else {}
@@ -118,6 +132,7 @@ RIDERS = {
     # shard-*/replication-* never cross-pollinate
     "replica-soak": ("replica-soak-*.json", _metrics_soak),
     "replication": ("replication-*.json", _metrics_shard),
+    "tier": ("tier-*.json", _metrics_tier),
 }
 
 

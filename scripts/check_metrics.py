@@ -75,6 +75,10 @@ REQUIRED_SERIES = [
     # (SDA_TS defaults on) and must have banked at least one window by
     # scrape time — main() shrinks the interval and waits for the tick
     "sda_ts_samples_total",
+    # hierarchical plane: drive_tier_round runs one 2-tier round, so the
+    # promotion counter and the depth gauge must both show
+    "sda_tier_promotions_total",
+    "sda_tier_depth",
 ]
 
 
@@ -154,6 +158,65 @@ def drive_workload(base_url: str, tmp: str) -> None:
         os.environ.pop("SDA_RESULT_PAGE_THRESHOLD", None)
         os.environ.pop("SDA_RESULT_CHUNK_SIZE", None)
         os.environ.pop("SDA_WORKERS", None)
+
+
+def drive_tier_round(base_url: str, tmp: str) -> None:
+    """One 2-tier hierarchical round (fan-out 2) over the live REST stack,
+    so the tier plane's series — sda_tier_promotions_total (server counts
+    sub-committee partials climbing into the root) and sda_tier_depth —
+    appear in the scrape, and the derived-tree provisioning + bottom-up
+    driver run against real HTTP at least once per CI pass."""
+    from sda_tpu.client import SdaClient, run_tier_round, setup_tier_round
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+
+    def new_client(subdir):
+        keystore = Keystore(os.path.join(tmp, subdir))
+        service = SdaHttpClient(base_url, TokenStore(os.path.join(tmp, subdir)))
+        return SdaClient(SdaClient.new_agent(keystore), keystore, service)
+
+    recipient = new_client("tier-recipient")
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="check-metrics-tiered",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
+        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+        sub_cohort_size=2,
+        tiers=2,
+    )
+    pool = [new_client(f"tier-clerk{i}") for i in range(2)]
+    for clerk in pool:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+    round = setup_tier_round(
+        recipient, agg, lambda name: new_client(f"tier-{name}"), pool
+    )
+    values = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
+    for i, v in enumerate(values):
+        p = new_client(f"tier-part{i}")
+        p.upload_agent()
+        p.participate(v, agg.id)
+    out = run_tier_round(round).output.positive()
+    assert list(out.values) == [15, 18, 21, 24], "tiered workload reveal disagrees"
+    status = recipient.service.get_tier_status(recipient.agent, agg.id)
+    assert status is not None and all(n.result_ready for n in status.nodes), \
+        "tier status route disagrees with the finished round"
 
 
 def drive_faulted_leg(base_url: str, tmp: str) -> None:
@@ -270,6 +333,7 @@ def main() -> int:
     with serve_background(server) as base_url, tempfile.TemporaryDirectory() as tmp:
         with telemetry.trace("ci-check-metrics"):
             drive_workload(base_url, tmp)
+        drive_tier_round(base_url, tmp)
         drive_faulted_leg(base_url, tmp)
         drive_engine()
         observability_errors = check_observability_routes(base_url)

@@ -40,6 +40,15 @@ Scenarios:
                             then serves a second exact reveal (file and
                             sqlite cells only: mem partitions have no
                             root to wedge across a process boundary)
+  sub-committee-clerk-killed  a 2-tier hierarchical round (disjoint
+                            committees) loses one clerk of one
+                            sub-committee after ingest; the sub-Shamir
+                            threshold reveals the partial from the
+                            survivors and the ROOT total is byte-exact
+  sub-cohort-vanishes       a 2-tier round loses an entire sub-cohort
+                            (its sub-aggregation deleted after ingest);
+                            the lenient driver skips it and the root
+                            reveals the exact sum of the survivors
 
 Each cell banks ``scenario-<name>-...-<store>-<transport>.json`` into the
 artifact dir (default bench-artifacts/); scripts/sweep_report.py rolls
@@ -781,6 +790,144 @@ def scenario_kill_shard_mid_round(dep: Deployment, seed: int) -> dict:
     }
 
 
+def _setup_tier_round(dep: Deployment, sharing, *, tiers: int, m: int,
+                      disjoint: bool, tag: str = "-tier"):
+    """Provision a tiered aggregation over the deployment cell: recipient,
+    clerk pool, derived tree + promoters via the client round driver."""
+    from sda_tpu.client import setup_tier_round
+    from sda_tpu.protocol import (
+        Aggregation,
+        AggregationId,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.protocol import tiers as tiers_mod
+
+    recipient = dep.client(f"recipient{tag}")
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    n_nodes = sum(m**t for t in range(tiers))
+    pool_size = sharing.output_size * n_nodes if disjoint else sharing.output_size
+    pool = [dep.client(f"clerk{tag}-{i}") for i in range(pool_size)]
+    for c in pool:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title=f"scenario{tag}",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=_chacha(),
+        committee_sharing_scheme=sharing,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+        sub_cohort_size=m,
+        tiers=tiers,
+    )
+    round = setup_tier_round(
+        recipient, agg, lambda name: dep.client(f"{tag}-{name}"), pool,
+        disjoint_committees=disjoint,
+    )
+    return recipient, round, agg, tiers_mod
+
+
+def scenario_sub_committee_clerk_killed(dep: Deployment, seed: int) -> dict:
+    """One clerk of ONE sub-committee dies after ingest (never clerks,
+    posts nothing — the vanish shape of vanish-after-sharing, one tier
+    down): the sub-committee's Shamir threshold reveals the partial sum
+    from the survivors, the promotion climbs, and the ROOT total is
+    byte-exact — a tier-local failure never poisons the hierarchy."""
+    from sda_tpu.client import run_tier_round
+    from sda_tpu.protocol import BasicShamirSharing
+
+    sharing = BasicShamirSharing(
+        share_count=3, privacy_threshold=1, prime_modulus=MODULUS
+    )
+    recipient, round, agg, _ = _setup_tier_round(
+        dep, sharing, tiers=2, m=2, disjoint=True, tag="-subkill"
+    )
+    values = [[i % 5, (i + 2) % 5, 1, i % 3] for i in range(6)]
+    for i, v in enumerate(values):
+        p = dep.client(f"part-subkill-{i}")
+        p.upload_agent()
+        p.participate(v, agg.id)
+    victim_node = round.nodes[1]
+    killed = victim_node.clerks[0]
+    # disjoint committees: the killed clerk serves no other node, so
+    # dropping it from the drain IS its death — no result ever posted
+    victim_node.clerks = victim_node.clerks[1:]
+    result = run_tier_round(round, strict=True)
+    expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
+    aggregate = [int(v) for v in result.output.positive().values]
+    if aggregate != expected:
+        raise AssertionError(f"aggregate mismatch: got {aggregate}, want {expected}")
+    return {
+        "tiers": 2,
+        "sub_cohorts": 2,
+        "committee": sharing.output_size,
+        "threshold": sharing.reconstruction_threshold,
+        "killed_clerk": str(killed.agent.id),
+        "killed_sub_committee": str(victim_node.aggregation.id),
+        "skipped": [str(s) for s in result.skipped],
+        "aggregate": aggregate,
+    }
+
+
+def scenario_sub_cohort_vanishes(dep: Deployment, seed: int) -> dict:
+    """An ENTIRE sub-cohort vanishes after ingest (its sub-aggregation is
+    deleted — the store-partition-death shape): the lenient round driver
+    skips it and the root reveals the EXACT sum of the surviving
+    sub-cohorts — degraded coverage, never a silently wrong total."""
+    from sda_tpu.client import run_tier_round
+    from sda_tpu.protocol import AdditiveSharing
+
+    sharing = AdditiveSharing(share_count=2, modulus=MODULUS)
+    recipient, round, agg, tiers_mod = _setup_tier_round(
+        dep, sharing, tiers=2, m=2, disjoint=False, tag="-cohort"
+    )
+    by_leaf: dict = {}
+    for i in range(6):
+        p = dep.client(f"part-cohort-{i}")
+        p.upload_agent()
+        v = [i % 5, (2 * i) % 7, 3, 1]
+        p.participate(v, agg.id)
+        by_leaf.setdefault(
+            tiers_mod.leaf_aggregation_id(agg, p.agent.id), []
+        ).append(v)
+    # lose the busier sub-cohort — the harder half to survive
+    victim_id = max(by_leaf, key=lambda leaf: len(by_leaf[leaf]))
+    victim = round.node(victim_id)
+    victim.owner.delete_aggregation(victim_id)
+    result = run_tier_round(round, strict=False)
+    if result.skipped != [victim_id]:
+        raise AssertionError(f"expected skip of {victim_id}, got {result.skipped}")
+    survivors = [v for leaf, vals in by_leaf.items() if leaf != victim_id
+                 for v in vals]
+    expected = [sum(v[d] for v in survivors) % MODULUS for d in range(DIM)]
+    aggregate = [int(v) for v in result.output.positive().values]
+    if aggregate != expected:
+        raise AssertionError(f"aggregate mismatch: got {aggregate}, want {expected}")
+    # the tier-status route agrees: the vanished node is gone, the root
+    # holds exactly the survivors' promotions and is result-ready
+    status = recipient.service.get_tier_status(recipient.agent, agg.id)
+    nodes = {n.aggregation: n for n in status.nodes}
+    if nodes[victim_id].exists:
+        raise AssertionError("vanished sub-aggregation still reported as existing")
+    root = nodes[agg.id]
+    if root.number_of_participations != len(by_leaf) - 1 or not root.result_ready:
+        raise AssertionError(f"root status off: {root}")
+    return {
+        "tiers": 2,
+        "sub_cohorts": 2,
+        "vanished": str(victim_id),
+        "lost_participations": len(by_leaf[victim_id]),
+        "survived_participations": len(survivors),
+        "aggregate": aggregate,
+    }
+
+
 SCENARIOS = {
     "register-never-submit": scenario_register_never_submit,
     "submit-mid-snapshot": scenario_submit_mid_snapshot,
@@ -789,6 +936,8 @@ SCENARIOS = {
     "duplicate-replay-malformed": scenario_duplicate_replay_malformed,
     "saturated-frontend": scenario_saturated_frontend,
     "kill-shard-mid-round": scenario_kill_shard_mid_round,
+    "sub-committee-clerk-killed": scenario_sub_committee_clerk_killed,
+    "sub-cohort-vanishes": scenario_sub_cohort_vanishes,
 }
 
 #: deployment shape overrides (Deployment kwargs) per scenario

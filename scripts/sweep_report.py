@@ -42,6 +42,15 @@ binary-vs-json ratio, the ratio against the recorded ~11K/s pre-binary
 JSON baseline (the wire plane's acceptance bar), the clerking-fetch and
 reveal ratios, and whether server RSS stayed flat across the legs.
 
+Also tabulates the tier-fanout rider artifacts
+(``bench-artifacts/tier-<stamp>.json``, written by bench.py's
+measure_tier_fanout): one row per fan-out config (flat baseline + each
+2-tier fan-out m) with the largest clerk job in columns, its ratio
+against the flat N, mean stage seconds per clerk job, clerked inputs
+per clerk-second, and the honestly-reported single-core round wall —
+the evidence that hierarchical committees shrink the per-clerk bound
+even where one CPU serializes every committee.
+
 Also rolls the churn harness's banked cells (``scenario-<name>-*.json``,
 written by scripts/scenarios.py) into the survivability matrix: scenario
 rows x (store, transport) columns, latest artifact per cell, OK / FAIL /
@@ -360,6 +369,66 @@ def print_wire(rows) -> None:
         )
 
 
+def load_tier(artdir: pathlib.Path):
+    """One row per fan-out config per tier-*.json artifact (flat baseline
+    first, then each 2-tier fan-out), with the per-clerk-bound columns and
+    the honestly-reported single-core wall ratio."""
+    rows = []
+    for f in sorted(artdir.glob("tier-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        configs = d.get("configs") if isinstance(d, dict) else None
+        if not isinstance(configs, dict):
+            continue
+        n = (d.get("config") or {}).get("n_participants")
+        # flat first, then fan-outs ascending — sorted() would interleave
+        for tag in ["flat"] + sorted(
+            (t for t in configs if t != "flat"),
+            key=lambda t: configs[t].get("fanout") or 0,
+        ):
+            cfg = configs.get(tag)
+            if not isinstance(cfg, dict) or cfg.get("max_job_participations") is None:
+                continue
+            rows.append(
+                {
+                    "artifact": f.name,
+                    "tag": tag,
+                    "n": n,
+                    "nodes": cfg.get("nodes"),
+                    "max_job": cfg.get("max_job_participations"),
+                    "vs_flat": cfg.get("vs_flat_max_job"),
+                    "per_job_s": cfg.get("per_job_stage_s"),
+                    "inputs_per_clerk_s": cfg.get("inputs_per_clerk_s"),
+                    "wall_s": cfg.get("wall_s"),
+                    "exact": cfg.get("exact"),
+                }
+            )
+    return rows
+
+
+def print_tier(rows) -> None:
+    print("\ntier-fanout riders (tier-*.json):")
+    print(
+        f"{'config':>8} {'n':>6} {'nodes':>5} {'max_job':>8} {'vs_flat':>8} "
+        f"{'job_s':>8} {'in/clk_s':>9} {'wall_s':>7} {'exact':>5}  artifact"
+    )
+    for r in rows:
+        per_job = f"{r['per_job_s']:.5f}" if r["per_job_s"] is not None else "-"
+        exact = "-" if r["exact"] is None else ("yes" if r["exact"] else "NO")
+        print(
+            f"{r['tag']:>8} {r['n'] if r['n'] is not None else '-':>6} "
+            f"{r['nodes'] if r['nodes'] is not None else '-':>5} "
+            f"{r['max_job']:>8} "
+            f"{r['vs_flat'] if r['vs_flat'] is not None else '-':>8} "
+            f"{per_job:>8} "
+            f"{r['inputs_per_clerk_s'] if r['inputs_per_clerk_s'] is not None else '-':>9} "
+            f"{r['wall_s'] if r['wall_s'] is not None else '-':>7} "
+            f"{exact:>5}  {r['artifact']}"
+        )
+
+
 def load_soak(artdir: pathlib.Path):
     """One row per soak-*.json artifact (scripts/load_soak.py): rounds and
     exactness, sample count, mean/max total request rate, the worst
@@ -527,6 +596,7 @@ def main() -> int:
     reveal_rows = load_reveal(artdir)
     committee_rows = load_committee(artdir)
     wire_rows = load_wire(artdir)
+    tier_rows = load_tier(artdir)
     soak_rows = load_soak(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
@@ -536,13 +606,14 @@ def main() -> int:
         and not reveal_rows
         and not committee_rows
         and not wire_rows
+        and not tier_rows
         and not soak_rows
         and not scenario_cells
     ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
-            f"reveal-*.json, committee-*.json, wire-*.json, soak-*.json, or "
-            f"scenario-*.json artifacts under {artdir}/",
+            f"reveal-*.json, committee-*.json, wire-*.json, tier-*.json, "
+            f"soak-*.json, or scenario-*.json artifacts under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -587,6 +658,8 @@ def main() -> int:
         print_committee(committee_rows)
     if wire_rows:
         print_wire(wire_rows)
+    if tier_rows:
+        print_tier(tier_rows)
     if soak_rows:
         print_soak(soak_rows)
     if scenario_cells:

@@ -14,6 +14,14 @@ from .committee import run_committee
 from .participate import Participating
 from .profile import Maintenance
 from .receive import Receiving, RecipientOutput
+from .tiers import (
+    TierRound,
+    TierRoundNode,
+    TierRoundResult,
+    promote_partial,
+    run_tier_round,
+    setup_tier_round,
+)
 
 
 class SdaClient(Participating, Clerking, Receiving, Maintenance):
@@ -40,4 +48,10 @@ __all__ = [
     "Maintenance",
     "RecipientOutput",
     "run_committee",
+    "TierRound",
+    "TierRoundNode",
+    "TierRoundResult",
+    "setup_tier_round",
+    "run_tier_round",
+    "promote_partial",
 ]

@@ -28,15 +28,18 @@ import numpy as np
 
 from .. import telemetry
 from ..protocol import Participation, ParticipationId
+from ..protocol import tiers as tiers_mod
 from .keys import VerifiedKeys
 
 
 class Participating(VerifiedKeys):
-    def participate(self, values, aggregation_id) -> None:
-        participation = self.new_participation(values, aggregation_id)
+    def participate(self, values, aggregation_id, *, route: bool = True) -> None:
+        participation = self.new_participation(values, aggregation_id, route=route)
         self.upload_participation(participation)
 
-    def participate_many(self, values_list, aggregation_id, chunk_size: int = 256) -> list:
+    def participate_many(
+        self, values_list, aggregation_id, chunk_size: int = 256, *, route: bool = True
+    ) -> list:
         """Build + upload one participation per entry of ``values_list``,
         batching both the crypto and the submission. Returns the ids.
 
@@ -88,7 +91,7 @@ class Participating(VerifiedKeys):
             t0 = time.perf_counter()
             with telemetry.span("ingest.build", rows=min(chunk_size, len(values_list) - lo)):
                 batch = self.new_participations(
-                    values_list[lo : lo + chunk_size], aggregation_id
+                    values_list[lo : lo + chunk_size], aggregation_id, route=route
                 )
             build_hist.observe(time.perf_counter() - t0)
             built_total.inc(len(batch))
@@ -111,20 +114,33 @@ class Participating(VerifiedKeys):
     def upload_participations(self, participations) -> None:
         self.service.create_participations(self.agent, list(participations))
 
-    def new_participation(self, values, aggregation_id) -> Participation:
-        return self.new_participations([values], aggregation_id)[0]
+    def new_participation(self, values, aggregation_id, *, route: bool = True) -> Participation:
+        return self.new_participations([values], aggregation_id, route=route)[0]
 
-    def new_participations(self, values_list, aggregation_id) -> list:
+    def new_participations(self, values_list, aggregation_id, *, route: bool = True) -> list:
         secrets_rows = [np.asarray(v, dtype=np.int64) for v in values_list]
 
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
             raise ValueError("Could not find aggregation")
+        if route and aggregation.is_tiered():
+            # hierarchical root: real participations belong to this
+            # participant's LEAF sub-aggregation, derived by pure hashing
+            # from the root record (protocol/tiers.py) — no extra server
+            # round-trips. Only tier promoters pass route=False to hit a
+            # tiered node directly (client/tiers.py).
+            leaf_id = tiers_mod.leaf_aggregation_id(aggregation, self.agent.id)
+            aggregation = self.service.get_aggregation(self.agent, leaf_id)
+            if aggregation is None:
+                raise ValueError(
+                    "tiered aggregation's sub-committees are not provisioned yet "
+                    "(run setup_tier_round first)"
+                )
         for secrets in secrets_rows:
             if len(secrets) != aggregation.vector_dimension:
                 raise ValueError("The input length does not match the aggregation.")
 
-        committee = self.service.get_committee(self.agent, aggregation_id)
+        committee = self.service.get_committee(self.agent, aggregation.id)
         if committee is None:
             raise ValueError("Could not find committee")
 

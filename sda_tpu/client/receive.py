@@ -115,6 +115,11 @@ class Receiving:
     def upload_aggregation(self, aggregation) -> None:
         self.service.create_aggregation(self.agent, aggregation)
 
+    def delete_aggregation(self, aggregation_id) -> None:
+        """Remove an aggregation this agent is the recipient of (a tiered
+        root's derived sub-aggregations cascade server-side)."""
+        self.service.delete_aggregation(self.agent, aggregation_id)
+
     def begin_aggregation(self, aggregation_id, *, chosen_clerks=None) -> None:
         """Elect the committee and open the aggregation for participation.
 

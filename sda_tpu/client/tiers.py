@@ -1,0 +1,219 @@
+"""Hierarchical round driver: provision the derived tree, run it bottom-up.
+
+The client half of tiered aggregation (arXiv 2201.00864 via
+protocol/tiers.py): a tiered aggregation is a TREE of ordinary
+aggregations, and a round is the flat pipeline run once per node —
+leaves first — with each sub-committee's revealed partial sum PROMOTED
+one tier up as an ordinary participation. The server never cascades
+anything; this module sequences the tree client-side, exactly like the
+flat flow sequences begin/participate/end/clerk/reveal.
+
+Roles per node: the root's recipient is the real recipient; every other
+node is owned by a PROMOTER — a throwaway agent that acts as the
+sub-aggregation's recipient (reveals the sub-cohort partial) and as a
+participant of the parent (re-submits it). Promoters therefore see their
+sub-cohort's partial sum in the clear; the paper's full scheme re-shares
+without revealing, which is future work (docs/ARCHITECTURE.md notes the
+deviation) — individual contributions remain protected by each leaf's
+masking + sharing either way.
+
+Exactness: every tier sums in the same modular group, so the root reveal
+equals the flat reveal byte-for-byte (partial residues are lifted to
+[0, m) with ``.positive()`` before promotion — the same lift the flat
+recipient applies at the end; tests/test_tiers.py holds the equality
+across schemes, stores, and transports).
+
+Dropout tolerance composes per tier: within a sub-committee, Shamir-family
+sharing reveals from any ``reconstruction_threshold`` survivors
+(receive.require_reconstructible); a whole sub-cohort that vanishes is
+simply absent from the parent's snapshot cut under ``strict=False``, and
+the root reveals the exact sum of the survivors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..protocol import tiers as tiers_mod
+from .committee import run_committee
+from .receive import RecipientOutput
+
+
+@dataclass
+class TierRoundNode:
+    """One provisioned node: its topology position, the stored
+    sub-aggregation record, the client that owns it (root recipient or
+    promoter), and its committee's clerk clients."""
+
+    node: tiers_mod.TierNode
+    aggregation: object
+    owner: object
+    clerks: list
+
+
+@dataclass
+class TierRound:
+    """A fully provisioned tiered round: root record, real recipient, and
+    every node of the derived tree (breadth-first, root first — the order
+    ``protocol.tiers.iter_tier_nodes`` enumerates)."""
+
+    root: object
+    recipient: object
+    nodes: list
+
+    def node(self, aggregation_id) -> Optional[TierRoundNode]:
+        for tn in self.nodes:
+            if tn.aggregation.id == aggregation_id:
+                return tn
+        return None
+
+    def leaves(self) -> list:
+        return [tn for tn in self.nodes if tn.node.is_leaf_of(self.root)]
+
+
+@dataclass
+class TierRoundResult:
+    """Outcome of ``run_tier_round``: the root reveal plus the
+    sub-aggregations skipped under ``strict=False`` (vanished sub-cohorts
+    or unrevealable sub-committees — the root total is the exact sum over
+    everything that did promote)."""
+
+    output: RecipientOutput
+    skipped: list = field(default_factory=list)
+
+
+def setup_tier_round(
+    recipient,
+    aggregation,
+    new_promoter: Callable[[str], object],
+    clerk_pool: list,
+    *,
+    disjoint_committees: bool = False,
+) -> TierRound:
+    """Provision the whole derived tree of a tiered ``aggregation``:
+    upload the root, derive + upload every sub-aggregation (parents
+    first), register one fresh promoter per non-root node, and elect
+    every node's committee from ``clerk_pool``.
+
+    ``new_promoter(name)`` must return a FRESH, unregistered client
+    (e.g. tests' ``new_client``); this function uploads its agent and
+    sodium key — the key the derived child record pins as its
+    recipient key. ``clerk_pool`` entries are registered clerk clients
+    that have already uploaded signed encryption keys (i.e. committee
+    candidates). Committees are consecutive slices of the pool, wrapping
+    — with ``disjoint_committees`` the pool must be large enough that no
+    clerk serves two nodes (the deployment shape the paper's per-clerk
+    bound assumes; a wrapped pool still COMPUTES correctly, each clerk
+    just works more than one node's share).
+    """
+    if not aggregation.is_tiered():
+        raise ValueError("setup_tier_round requires a tiered aggregation")
+    topology = tiers_mod.iter_tier_nodes(aggregation)
+    size = aggregation.committee_sharing_scheme.output_size
+    if disjoint_committees:
+        if len(clerk_pool) < size * len(topology):
+            raise ValueError(
+                f"disjoint committees need {size * len(topology)} clerks, "
+                f"pool has {len(clerk_pool)}"
+            )
+    elif len(clerk_pool) < size:
+        raise ValueError(
+            f"clerk pool smaller than one committee ({len(clerk_pool)} < {size})"
+        )
+
+    recipient.upload_aggregation(aggregation)
+    records = {aggregation.id: aggregation}
+    nodes = []
+    for position, node in enumerate(topology):
+        if node.parent is None:
+            agg, owner = aggregation, recipient
+        else:
+            promoter = new_promoter(f"tier{node.tier}-sub{position}")
+            promoter.upload_agent()
+            promoter_key = promoter.new_encryption_key()
+            promoter.upload_encryption_key(promoter_key)
+            agg = tiers_mod.child_aggregation(
+                records[node.parent], node.index, promoter.agent.id, promoter_key
+            )
+            promoter.upload_aggregation(agg)
+            records[agg.id] = agg
+            owner = promoter
+        clerks = [
+            clerk_pool[(position * size + j) % len(clerk_pool)] for j in range(size)
+        ]
+        owner.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+        nodes.append(TierRoundNode(node=node, aggregation=agg, owner=owner, clerks=clerks))
+    return TierRound(root=aggregation, recipient=recipient, nodes=nodes)
+
+
+def promote_partial(promoter, values, parent_aggregation_id):
+    """Submit a revealed sub-cohort partial sum as an ordinary
+    participation of the PARENT tier. ``route=False`` is the whole trick:
+    a promoter targets its parent node directly instead of being hashed
+    down to a leaf like a real participant. Returns the participation id
+    (idempotently replayable like any other participation)."""
+    parts = promoter.new_participations(
+        [values], parent_aggregation_id, route=False
+    )
+    promoter.upload_participations(parts)
+    return parts[0].id
+
+
+def _drain_clerks(entries, max_iterations: int) -> None:
+    # one clerk client may serve several nodes' committees (wrapped
+    # pool); drain each AGENT once per tier or the same durable queue
+    # would be polled by several equivalent client objects
+    seen, clerks = set(), []
+    for tn in entries:
+        for clerk in tn.clerks:
+            if clerk.agent.id not in seen:
+                seen.add(clerk.agent.id)
+                clerks.append(clerk)
+    run_committee(clerks, max_iterations)
+
+
+def run_tier_round(
+    round: TierRound, *, max_iterations: int = -1, strict: bool = True
+) -> TierRoundResult:
+    """Run a provisioned tiered round bottom-up and reveal the root.
+
+    Per tier, deepest first: close every node (freezing its sub-cohort's
+    participations into a snapshot), drain that tier's clerks, then each
+    promoter reveals its partial sum — lifted to ``[0, modulus)`` — and
+    promotes it into the parent. The root closes last, over exactly its
+    children's promotions, and the real recipient reveals the total.
+
+    ``strict=False`` tolerates failed sub-aggregations (vanished
+    sub-cohort, unrevealable sub-committee): they are recorded in
+    ``TierRoundResult.skipped`` and the root reveals the exact sum of
+    the survivors. Under ``strict=True`` any sub-tier failure raises.
+    """
+    depth = tiers_mod.tier_depth(round.root)
+    skipped = []
+    for tier in range(depth - 1, 0, -1):
+        entries = [tn for tn in round.nodes if tn.node.tier == tier]
+        live = []
+        for tn in entries:
+            try:
+                tn.owner.end_aggregation(tn.aggregation.id)
+            except Exception:
+                if strict:
+                    raise
+                skipped.append(tn.aggregation.id)
+                continue
+            live.append(tn)
+        _drain_clerks(live, max_iterations)
+        for tn in live:
+            try:
+                partial = tn.owner.reveal_aggregation(tn.aggregation.id).positive()
+            except Exception:
+                if strict:
+                    raise
+                skipped.append(tn.aggregation.id)
+                continue
+            promote_partial(tn.owner, partial.values, tn.node.parent)
+    round.recipient.end_aggregation(round.root.id)
+    _drain_clerks([round.nodes[0]], max_iterations)
+    output = round.recipient.reveal_aggregation(round.root.id)
+    return TierRoundResult(output=output, skipped=skipped)

@@ -132,6 +132,18 @@ class SdaService(abc.ABC):
     def get_aggregation_status(self, caller, aggregation_id):
         """Poll aggregation status (participations, snapshots, readiness)."""
 
+    def get_tier_status(self, caller, aggregation_id):
+        """Per-node readiness of a TIERED aggregation's derived tree
+        (``TierStatus``, nodes in breadth-first order, root first), or
+        None for a flat or unknown aggregation. Recipient-only, like
+        ``get_aggregation_status``. Compatibility shim rationale as the
+        paged-delivery defaults: a binding predating tiered aggregation
+        never creates one, so reaching this default means a
+        binding/version mismatch."""
+        raise NotImplementedError(
+            "this SdaService binding does not support tiered aggregations"
+        )
+
     @abc.abstractmethod
     def create_snapshot(self, caller, snapshot) -> None:
         """Freeze a consistent subset of participations and build clerk jobs."""

@@ -96,7 +96,18 @@ def signed_encryption_key_from_json(obj) -> Signed:
 
 @dataclass
 class Aggregation:
-    """Description of an aggregation (resources.rs:44-67)."""
+    """Description of an aggregation (resources.rs:44-67).
+
+    ``sub_cohort_size`` / ``tiers`` are the hierarchical-plane extension
+    (arXiv 2201.00864): a TIERED aggregation (``tiers >= 2``) partitions
+    its participants into ``sub_cohort_size`` sub-cohorts per node by
+    deterministic hash, each aggregated by its own sub-committee, with
+    partial sums re-shared upward until the root committee reveals the
+    exact total (protocol/tiers.py derives the whole tree from this one
+    record). Both fields are emitted only when set, so FLAT aggregations
+    — the default — keep the original ten-key wire shape and canonical
+    signing bytes, byte for byte.
+    """
 
     id: AggregationId
     title: str
@@ -108,9 +119,14 @@ class Aggregation:
     committee_sharing_scheme: LinearSecretSharingScheme
     recipient_encryption_scheme: AdditiveEncryptionScheme
     committee_encryption_scheme: AdditiveEncryptionScheme
+    sub_cohort_size: Optional[int] = None  # fan-out m per tiered node
+    tiers: Optional[int] = None  # committee tiers; absent/1 = flat
+
+    def is_tiered(self) -> bool:
+        return (self.tiers or 1) > 1
 
     def to_json(self):
-        return {
+        obj = {
             "id": self.id.to_json(),
             "title": self.title,
             "vector_dimension": self.vector_dimension,
@@ -122,6 +138,11 @@ class Aggregation:
             "recipient_encryption_scheme": self.recipient_encryption_scheme.to_json(),
             "committee_encryption_scheme": self.committee_encryption_scheme.to_json(),
         }
+        if self.sub_cohort_size is not None:
+            obj["sub_cohort_size"] = self.sub_cohort_size
+        if self.tiers is not None:
+            obj["tiers"] = self.tiers
+        return obj
 
     @classmethod
     def from_json(cls, obj):
@@ -142,6 +163,8 @@ class Aggregation:
             committee_encryption_scheme=AdditiveEncryptionScheme.from_json(
                 obj["committee_encryption_scheme"]
             ),
+            sub_cohort_size=_opt(obj.get("sub_cohort_size"), int),
+            tiers=_opt(obj.get("tiers"), int),
         )
 
 
@@ -426,6 +449,74 @@ class SnapshotResult:
             mask_encryption_count=_opt(obj.get("mask_encryption_count"), int),
             clerk_result_count=_opt(obj.get("clerk_result_count"), int),
             chunk_size=_opt(obj.get("chunk_size"), int),
+        )
+
+
+@dataclass
+class TierNodeStatus:
+    """Status of one node of a tiered aggregation's derived tree.
+
+    ``exists`` is False for a node whose sub-aggregation record was never
+    provisioned (the topology is derived, not stored — see
+    protocol/tiers.py); counts are zero for such nodes. ``result_ready``
+    means at least one of the node's snapshots has collected enough clerk
+    results to reconstruct."""
+
+    aggregation: AggregationId
+    tier: int
+    parent: Optional[AggregationId]
+    exists: bool
+    number_of_participations: int
+    result_ready: bool
+
+    def to_json(self):
+        return {
+            "aggregation": self.aggregation.to_json(),
+            "tier": self.tier,
+            "parent": _opt(self.parent, lambda p: p.to_json()),
+            "exists": self.exists,
+            "number_of_participations": self.number_of_participations,
+            "result_ready": self.result_ready,
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+            tier=int(obj["tier"]),
+            parent=_opt(obj.get("parent"), AggregationId.from_json),
+            exists=bool(obj["exists"]),
+            number_of_participations=int(obj["number_of_participations"]),
+            result_ready=bool(obj["result_ready"]),
+        )
+
+
+@dataclass
+class TierStatus:
+    """Per-node readiness of a tiered aggregation's whole derived tree,
+    root first in breadth-first order (additive resource, no reference
+    counterpart)."""
+
+    aggregation: AggregationId
+    tiers: int
+    sub_cohort_size: int
+    nodes: list  # list[TierNodeStatus], BFS order, root first
+
+    def to_json(self):
+        return {
+            "aggregation": self.aggregation.to_json(),
+            "tiers": self.tiers,
+            "sub_cohort_size": self.sub_cohort_size,
+            "nodes": [n.to_json() for n in self.nodes],
+        }
+
+    @classmethod
+    def from_json(cls, obj):
+        return cls(
+            aggregation=AggregationId.from_json(obj["aggregation"]),
+            tiers=int(obj["tiers"]),
+            sub_cohort_size=int(obj["sub_cohort_size"]),
+            nodes=[TierNodeStatus.from_json(n) for n in obj["nodes"]],
         )
 
 

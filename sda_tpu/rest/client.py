@@ -59,6 +59,7 @@ from ..protocol import (
     SdaError,
     SdaService,
     SnapshotResult,
+    TierStatus,
     signed_encryption_key_from_json,
 )
 
@@ -445,6 +446,13 @@ class SdaHttpClient(SdaService):
             route_key=aggregation_id,
         )
         return None if obj is None else AggregationStatus.from_json(obj)
+
+    def get_tier_status(self, caller, aggregation_id):
+        obj = self._request(
+            "GET", f"/v1/aggregations/{quote(str(aggregation_id))}/tiers", caller,
+            route_key=aggregation_id,
+        )
+        return None if obj is None else TierStatus.from_json(obj)
 
     def create_snapshot(self, caller, snapshot) -> None:
         self._request("POST", "/v1/aggregations/implied/snapshot", caller,

@@ -690,6 +690,14 @@ class _RequestContext:
             )
             return True
 
+        if method == "GET" and (match := m(rf"/v1/aggregations/({_UUID})/tiers")):
+            # per-node readiness of a tiered aggregation's derived tree
+            # (recipient-only by ACL); 404 for flat aggregations
+            self._send_json_option(
+                svc.get_tier_status(self._caller(), AggregationId(match.group(1)))
+            )
+            return True
+
         if method == "POST" and path == "/v1/aggregations/implied/snapshot":
             svc.create_snapshot(self._caller(), self._read(Snapshot.from_json))
             self._send(201)

@@ -24,7 +24,10 @@ from ..protocol import (
     ServerError,
     SnapshotResult,
     SnapshotStatus,
+    TierNodeStatus,
+    TierStatus,
 )
+from ..protocol import tiers as tiers_mod
 from . import snapshot as snapshot_mod
 from . import stores
 
@@ -131,9 +134,43 @@ class SdaServer:
                 raise InvalidRequestError(
                     "mask values would not fit the Paillier component bound"
                 )
+        # hierarchical knobs travel together: tiers counts committee levels
+        # (so 1 is just "flat" and must be spelled as absence — the fields
+        # are omitted from wire/signing bytes when unset, and an explicit
+        # tiers=1 would make two byte-encodings of the same flat semantics)
+        if aggregation.tiers is not None or aggregation.sub_cohort_size is not None:
+            t, m = aggregation.tiers, aggregation.sub_cohort_size
+            if t is None or m is None:
+                raise InvalidRequestError(
+                    "tiers and sub_cohort_size must be set together"
+                )
+            if not 2 <= t <= tiers_mod.MAX_TIERS:
+                raise InvalidRequestError(
+                    f"tiers must be in [2, {tiers_mod.MAX_TIERS}] "
+                    "(flat aggregations omit the field)"
+                )
+            if not 2 <= m <= tiers_mod.MAX_SUB_COHORTS:
+                raise InvalidRequestError(
+                    f"sub_cohort_size must be in [2, {tiers_mod.MAX_SUB_COHORTS}]"
+                )
+            telemetry.gauge(
+                "sda_tier_depth",
+                "committee levels of the most recently created tiered aggregation",
+            ).set(t)
         self.aggregation_store.create_aggregation(aggregation)
 
     def delete_aggregation(self, aggregation_id) -> None:
+        # a tiered root's sub-aggregations are DERIVED state of the root
+        # record (protocol/tiers.py), so deleting the root cascades over
+        # every provisioned node of its tree — orphaned sub-aggregations
+        # would otherwise hold participations no one can ever reveal
+        agg = self.aggregation_store.get_aggregation(aggregation_id)
+        if agg is not None and agg.is_tiered():
+            for node in tiers_mod.iter_tier_nodes(agg):
+                if node.parent is None:
+                    continue
+                if self.aggregation_store.get_aggregation(node.aggregation_id) is not None:
+                    self.aggregation_store.delete_aggregation(node.aggregation_id)
         self.aggregation_store.delete_aggregation(aggregation_id)
 
     def _sodium_key_of(self, key_id, owner):
@@ -228,6 +265,7 @@ class SdaServer:
         agg = self.aggregation_store.get_aggregation(participation.aggregation)
         self._validate_participation(participation, committee, agg)
         self.aggregation_store.create_participation(participation)
+        self._count_promotion(agg, 1)
 
     def create_participations(self, participations) -> None:
         """Batched ingest: every item passes the exact single-item checks
@@ -248,6 +286,22 @@ class SdaServer:
                     expected[a] = [clerk for (clerk, _) in committees[a].clerks_and_keys]
             self._validate_participation(p, committees[a], aggs[a], expected.get(a))
         self.aggregation_store.create_participations(participations)
+        for a, agg in aggs.items():
+            self._count_promotion(agg, sum(1 for p in participations if p.aggregation == a))
+
+    @staticmethod
+    def _count_promotion(agg, n: int) -> None:
+        """Every participation accepted into a TIERED aggregation is a
+        promotion by construction: real participants route to leaf
+        sub-aggregations (which are flat), so anything landing on a node
+        with tiers > 1 is a sub-committee's revealed partial sum climbing
+        one level (client/tiers.py)."""
+        if n and agg is not None and agg.is_tiered():
+            telemetry.counter(
+                "sda_tier_promotions_total",
+                "partial-sum promotions accepted into parent-tier aggregations",
+                tier=str(agg.tiers),
+            ).inc(n)
 
     def _validate_recipient_encryption(self, participation, agg) -> None:
         """Shape-check the recipient (mask) ciphertext at the door. For
@@ -299,6 +353,39 @@ class SdaServer:
                 aggregation_id
             ),
             snapshots=snapshots,
+        )
+
+    def get_tier_status(self, aggregation_id) -> Optional[TierStatus]:
+        """Readiness of every node of a tiered aggregation's derived tree,
+        BFS order root first — the recipient's one-call view of how far the
+        bottom-up round has climbed. None for flat/unknown aggregations.
+        The tree is enumerated from the root record alone (protocol/
+        tiers.py); nodes the round driver has not provisioned yet report
+        ``exists=False``."""
+        agg = self.aggregation_store.get_aggregation(aggregation_id)
+        if agg is None or not agg.is_tiered():
+            return None
+        nodes = []
+        for node in tiers_mod.iter_tier_nodes(agg):
+            st = self.get_aggregation_status(node.aggregation_id)
+            nodes.append(
+                TierNodeStatus(
+                    aggregation=node.aggregation_id,
+                    tier=node.tier,
+                    parent=node.parent,
+                    exists=st is not None,
+                    number_of_participations=0
+                    if st is None
+                    else st.number_of_participations,
+                    result_ready=st is not None
+                    and any(s.result_ready for s in st.snapshots),
+                )
+            )
+        return TierStatus(
+            aggregation=aggregation_id,
+            tiers=agg.tiers,
+            sub_cohort_size=agg.sub_cohort_size,
+            nodes=nodes,
         )
 
     def create_snapshot(self, snapshot) -> None:
@@ -503,6 +590,10 @@ class SdaServerService(SdaService):
     def get_aggregation_status(self, caller, aggregation_id):
         self._acl_recipient(caller, aggregation_id)
         return self.server.get_aggregation_status(aggregation_id)
+
+    def get_tier_status(self, caller, aggregation_id):
+        self._acl_recipient(caller, aggregation_id)
+        return self.server.get_tier_status(aggregation_id)
 
     def create_snapshot(self, caller, snapshot) -> None:
         self._acl_recipient(caller, snapshot.aggregation)

@@ -5,6 +5,22 @@ participation set, transpose the (participants x clerks) ciphertext matrix,
 enqueue one durable ClerkingJob per committee member, persist the snapshot,
 and (when the scheme masks) collect every participation's recipient
 encryption into the snapshot mask blob.
+
+The run is an explicit STAGE PIPELINE (``SNAPSHOT_STAGES``): freeze →
+job fan-out → mask collect → commit, each stage a named function over the
+same (server, aggregation, snapshot) triple. Everything before the commit
+stage is idempotent — membership freeze is write-once, job ids
+deterministic, mask blob a plain overwrite of identical content — so a
+crashed run retried by the client replays cleanly into the stores'
+create-if-identical semantics.
+
+Hierarchical aggregations run this SAME pipeline once per node of their
+derived tree (protocol/tiers.py): each sub-aggregation's snapshot fans
+its sub-cohort's columns out to its own sub-committee, so per-clerk work
+is O(cohort/m) instead of O(cohort). ``snapshot_dag`` exposes the
+execution order — leaves first, root last, each node's snapshot
+depending on its children's promotions having landed — which the client
+round driver (client/tiers.py) walks bottom-up.
 """
 
 from __future__ import annotations
@@ -13,6 +29,7 @@ import logging
 import uuid
 
 from ..protocol import ClerkingJob, ClerkingJobId, ServerError
+from ..protocol import tiers as tiers_mod
 from ..utils.metrics import get_metrics
 from . import stores as stores_mod
 
@@ -29,24 +46,33 @@ def _job_id(snapshot_id, clerk_index: int) -> ClerkingJobId:
     return ClerkingJobId(uuid.uuid5(_JOB_NAMESPACE, f"{snapshot_id}:{clerk_index}"))
 
 
-def run_snapshot(server, snapshot) -> None:
-    aggregation = server.aggregation_store.get_aggregation(snapshot.aggregation)
-    if aggregation is None:
-        raise ServerError("lost aggregation")
+def snapshot_dag(aggregation) -> list:
+    """The sub-aggregation DAG a full round of ``aggregation`` snapshots
+    through, in execution order: leaves first, root last (reverse
+    breadth-first over the derived tree). Each entry is a
+    ``protocol.tiers.TierNode``; a node's snapshot may only be cut after
+    its children's partial sums have been promoted into it, which is
+    exactly the reversed-BFS order. Flat aggregations yield a
+    single-node DAG — the degenerate tree."""
+    return list(reversed(tiers_mod.iter_tier_nodes(aggregation)))
 
-    # Idempotent retry: the snapshot id is client-chosen; re-submitting an
-    # existing snapshot must not enqueue a second set of clerking jobs
-    # (duplicate results would double-count toward result_ready).
-    if server.aggregation_store.get_snapshot(snapshot.aggregation, snapshot.id) is not None:
-        log.debug("snapshot %s: already exists, retry is a no-op", snapshot.id)
-        return
 
+# -- pipeline stages ---------------------------------------------------------
+
+
+def _stage_freeze(server, aggregation, snapshot) -> None:
+    """Freeze the participation set: the consistent cut every later stage
+    (and every retry) reads. Write-once per (aggregation, snapshot)."""
+    with get_metrics().phase("snapshot.freeze"):
+        server.aggregation_store.snapshot_participations(
+            snapshot.aggregation, snapshot.id
+        )
+
+
+def _stage_fanout_jobs(server, aggregation, snapshot) -> None:
+    """Transpose the frozen (participants x clerks) ciphertext matrix and
+    enqueue one durable ClerkingJob per committee member."""
     metrics = get_metrics()
-    metrics.count("snapshots")
-    log.debug("snapshot %s: freezing participations", snapshot.id)
-    with metrics.phase("snapshot.freeze"):
-        server.aggregation_store.snapshot_participations(snapshot.aggregation, snapshot.id)
-
     committee = server.aggregation_store.get_committee(snapshot.aggregation)
     if committee is None:
         raise ServerError("lost committee")
@@ -96,26 +122,59 @@ def run_snapshot(server, snapshot) -> None:
                 chunks,
             )
 
-    if aggregation.masking_scheme.has_mask():
-        log.debug("snapshot %s: collecting masking data", snapshot.id)
-        recipient_encryptions = []
-        for part in server.aggregation_store.iter_snapped_participations(
-            snapshot.aggregation, snapshot.id
-        ):
-            if part.recipient_encryption is None:
-                raise ServerError("participation should have had a recipient encryption")
-            recipient_encryptions.append(part.recipient_encryption)
-        recipient_encryptions = _maybe_combine_masks(
-            server, aggregation, recipient_encryptions
-        )
-        server.aggregation_store.create_snapshot_mask(snapshot.id, recipient_encryptions)
 
-    # persisting the snapshot record is the COMMIT POINT: the retry guard
-    # above keys on it, so everything before this line must be (and is)
-    # idempotent — membership freeze is write-once, job ids deterministic,
-    # mask blob a plain overwrite of identical content.
+def _stage_collect_masks(server, aggregation, snapshot) -> None:
+    """Gather every frozen participation's recipient encryption into the
+    snapshot mask blob (skipped entirely for non-masking schemes)."""
+    if not aggregation.masking_scheme.has_mask():
+        return
+    log.debug("snapshot %s: collecting masking data", snapshot.id)
+    recipient_encryptions = []
+    for part in server.aggregation_store.iter_snapped_participations(
+        snapshot.aggregation, snapshot.id
+    ):
+        if part.recipient_encryption is None:
+            raise ServerError("participation should have had a recipient encryption")
+        recipient_encryptions.append(part.recipient_encryption)
+    recipient_encryptions = _maybe_combine_masks(
+        server, aggregation, recipient_encryptions
+    )
+    server.aggregation_store.create_snapshot_mask(snapshot.id, recipient_encryptions)
+
+
+def _stage_commit(server, aggregation, snapshot) -> None:
+    """Persist the snapshot record — the COMMIT POINT: the retry guard in
+    ``run_snapshot`` keys on it, so every earlier stage must be (and is)
+    idempotent."""
     server.aggregation_store.create_snapshot(snapshot)
 
+
+#: the pipeline, in order; each stage is f(server, aggregation, snapshot).
+#: Every stage before the final commit is idempotent by construction.
+SNAPSHOT_STAGES = (
+    _stage_freeze,
+    _stage_fanout_jobs,
+    _stage_collect_masks,
+    _stage_commit,
+)
+
+
+def run_snapshot(server, snapshot) -> None:
+    aggregation = server.aggregation_store.get_aggregation(snapshot.aggregation)
+    if aggregation is None:
+        raise ServerError("lost aggregation")
+
+    # Idempotent retry: the snapshot id is client-chosen; re-submitting an
+    # existing snapshot must not enqueue a second set of clerking jobs
+    # (duplicate results would double-count toward result_ready).
+    if server.aggregation_store.get_snapshot(snapshot.aggregation, snapshot.id) is not None:
+        log.debug("snapshot %s: already exists, retry is a no-op", snapshot.id)
+        return
+
+    get_metrics().count("snapshots")
+    log.debug("snapshot %s: freezing participations", snapshot.id)
+    for stage in SNAPSHOT_STAGES:
+        stage(server, aggregation, snapshot)
     log.debug("snapshot %s: done", snapshot.id)
 
 

@@ -222,6 +222,45 @@ def test_wire_rider_section(tmp_path, capsys):
     assert "wire-broken.json" not in out
 
 
+def test_tier_rider_section(tmp_path, capsys):
+    _write(tmp_path, "tier-20260806-010000.json",
+           {"metric": "tier_fanout",
+            "config": {"n_participants": 48, "fanouts": [2, 4, 8],
+                       "tiers": 2, "cpu_count": 1},
+            "configs": {
+                "flat": {"fanout": None, "exact": True, "wall_s": 0.68,
+                         "nodes": 1, "max_job_participations": 48,
+                         "per_job_stage_s": 0.0068,
+                         "inputs_per_clerk_s": 3529},
+                "m4": {"fanout": 4, "exact": True, "wall_s": 0.7,
+                       "nodes": 5, "max_job_participations": 15,
+                       "vs_flat_max_job": 0.312, "vs_flat_wall": 1.03,
+                       "per_job_stage_s": 0.00084,
+                       "inputs_per_clerk_s": 6667},
+                "m2": {"fanout": 2, "exact": True, "wall_s": 0.59,
+                       "nodes": 3, "max_job_participations": 27,
+                       "vs_flat_max_job": 0.562, "vs_flat_wall": 0.86,
+                       "per_job_stage_s": 0.00101,
+                       "inputs_per_clerk_s": 8571}}})
+    _write(tmp_path, "tier-broken.json", {"note": "no configs"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # tier rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "tier-fanout riders" in out
+    assert "tier-20260806-010000.json" in out
+    assert "tier-broken.json" not in out
+    # flat baseline leads, then fan-outs ascending (not lexicographic)
+    lines = [ln for ln in out.splitlines() if "tier-20260806-010000" in ln]
+    assert [ln.split()[0] for ln in lines] == ["flat", "m2", "m4"]
+    assert "0.312" in out   # per-clerk bound ratio vs flat
+    assert "0.00084" in out  # mean stage seconds per clerk job
+
+
 def test_soak_rider_section(tmp_path, capsys):
     _write(tmp_path, "soak-20260806-010000.json",
            {"kind": "soak",

@@ -1,0 +1,437 @@
+"""Hierarchical multi-tier committees: topology, exactness, resilience.
+
+The contract under test is the tentpole's: a tiered aggregation is a
+TREE of ordinary aggregations derived purely from the root record
+(protocol/tiers.py), and the bottom-up round — sub-committees clerk
+their sub-cohorts, promoters climb partial sums, the root committee
+reveals — must produce a total BYTE-IDENTICAL to the flat pipeline over
+the same inputs, for every sharing scheme and fan-out. Store and
+transport ride the usual env matrix (``with_service``:
+SDA_TEST_STORE x SDA_TEST_HTTP), so every cell here also runs over
+file/sqlite stores and the REST stack in CI.
+
+Also held: deterministic topology (ids, cohort assignment, BFS
+enumeration), the wire discipline (flat records encode without the tier
+keys, so signing bytes are unchanged from the pre-tier protocol),
+server-side validation, participant leaf-routing, tier status, the
+delete cascade, vanished-sub-cohort survival, promotion telemetry, and
+a tiered round over the sharded coordination plane (K=2).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from sda_fixtures import new_client, with_service
+from sda_tpu import telemetry
+from sda_tpu.client import run_committee, run_tier_round, setup_tier_round
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    BasicShamirSharing,
+    ChaChaMasking,
+    EncryptionKeyId,
+    InvalidRequestError,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.protocol import tiers as tiers_mod
+
+MODULUS = 433
+DIM = 4
+
+SHARINGS = {
+    "additive": lambda: AdditiveSharing(share_count=3, modulus=MODULUS),
+    "shamir": lambda: BasicShamirSharing(
+        share_count=5, privacy_threshold=2, prime_modulus=MODULUS
+    ),
+    "packed": lambda: PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=MODULUS,
+        omega_secrets=354,
+        omega_shares=150,
+    ),
+}
+
+
+def _aggregation(sharing, tiers=None, m=None) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="tiers-test",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=ChaChaMasking(modulus=MODULUS, dimension=DIM, seed_bitsize=128),
+        committee_sharing_scheme=sharing,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+        sub_cohort_size=m,
+        tiers=tiers,
+    )
+
+
+# -- topology: pure derivation ----------------------------------------------
+
+
+def test_child_ids_deterministic_and_distinct():
+    root = AggregationId.random()
+    a, b = tiers_mod.child_aggregation_id(root, 0), tiers_mod.child_aggregation_id(root, 0)
+    assert a == b
+    kids = {tiers_mod.child_aggregation_id(root, i) for i in range(8)}
+    assert len(kids) == 8 and root not in kids
+
+
+def test_cohort_assignment_in_range_salted_and_covering():
+    node_a, node_b = AggregationId.random(), AggregationId.random()
+    parts = [AgentId.random() for _ in range(64)]
+    for m in (2, 4, 8):
+        slots = [tiers_mod.assign_sub_cohort(node_a, p, m) for p in parts]
+        assert all(0 <= s < m for s in slots)
+        # 64 hashes over <=8 buckets: every bucket occupied (p_miss ~ 1e-4)
+        assert len(set(slots)) == m
+    # per-node salt: the same cohort at two nodes would leak tier structure
+    a = [tiers_mod.assign_sub_cohort(node_a, p, 8) for p in parts]
+    b = [tiers_mod.assign_sub_cohort(node_b, p, 8) for p in parts]
+    assert a != b
+    with pytest.raises(ValueError):
+        tiers_mod.assign_sub_cohort(node_a, parts[0], 0)
+
+
+@pytest.mark.parametrize("tiers,m", [(2, 2), (2, 4), (3, 2)])
+def test_iter_tier_nodes_enumerates_bfs(tiers, m):
+    root = _aggregation(SHARINGS["additive"](), tiers=tiers, m=m)
+    nodes = tiers_mod.iter_tier_nodes(root)
+    assert len(nodes) == sum(m**t for t in range(tiers))
+    assert nodes[0].aggregation_id == root.id and nodes[0].parent is None
+    # BFS: tiers are contiguous and non-decreasing; each child's parent
+    # appears earlier in the enumeration
+    seen = {root.id}
+    last_tier = 0
+    for node in nodes[1:]:
+        assert node.tier >= last_tier
+        last_tier = node.tier
+        assert node.parent in seen
+        seen.add(node.aggregation_id)
+    leaves = [n for n in nodes if n.is_leaf_of(root)]
+    assert len(leaves) == m ** (tiers - 1)
+
+
+def test_leaf_routing_walks_the_tree():
+    root = _aggregation(SHARINGS["additive"](), tiers=3, m=2)
+    leaf_ids = {
+        n.aggregation_id for n in tiers_mod.iter_tier_nodes(root) if n.is_leaf_of(root)
+    }
+    for _ in range(16):
+        p = AgentId.random()
+        leaf = tiers_mod.leaf_aggregation_id(root, p)
+        assert leaf in leaf_ids
+        assert leaf == tiers_mod.leaf_aggregation_id(root, p)  # stable
+    # a flat aggregation routes to itself
+    flat = _aggregation(SHARINGS["additive"]())
+    assert tiers_mod.leaf_aggregation_id(flat, AgentId.random()) == flat.id
+
+
+def test_child_aggregation_decrements_and_pins_sodium():
+    from sda_tpu.protocol import PackedPaillierEncryptionScheme
+
+    root = _aggregation(SHARINGS["shamir"](), tiers=3, m=2)
+    root.recipient_encryption_scheme = PackedPaillierEncryptionScheme(
+        component_count=4,
+        component_bitsize=32,
+        max_value_bitsize=16,
+        min_modulus_bitsize=2048,
+    )
+    promoter, key = AgentId.random(), EncryptionKeyId.random()
+    mid = tiers_mod.child_aggregation(root, 1, promoter, key)
+    assert mid.id == tiers_mod.child_aggregation_id(root.id, 1)
+    assert (mid.tiers, mid.sub_cohort_size) == (2, 2)
+    assert mid.recipient == promoter and mid.recipient_key == key
+    # Paillier mask transport is root-only; promoters hold sodium keys
+    assert isinstance(mid.recipient_encryption_scheme, SodiumEncryptionScheme)
+    assert mid.committee_sharing_scheme == root.committee_sharing_scheme
+    assert mid.masking_scheme == root.masking_scheme
+    leaf = tiers_mod.child_aggregation(mid, 0, promoter, key)
+    assert leaf.tiers is None and leaf.sub_cohort_size is None
+    assert not leaf.is_tiered()
+
+
+# -- wire discipline ---------------------------------------------------------
+
+
+def test_flat_wire_bytes_unchanged():
+    """Flat records must encode WITHOUT the tier keys — their canonical
+    (signing) bytes are identical to the pre-tier protocol's."""
+    flat = _aggregation(SHARINGS["additive"]())
+    obj = flat.to_json()
+    assert "tiers" not in obj and "sub_cohort_size" not in obj
+    assert Aggregation.from_json(obj) == flat
+
+    tiered = _aggregation(SHARINGS["additive"](), tiers=2, m=4)
+    obj = tiered.to_json()
+    assert obj["tiers"] == 2 and obj["sub_cohort_size"] == 4
+    rt = Aggregation.from_json(obj)
+    assert rt == tiered and rt.is_tiered()
+
+
+# -- server-side validation --------------------------------------------------
+
+
+def test_tier_validation_rejections(tmp_path):
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+
+        def submit(tiers, m):
+            agg = _aggregation(SHARINGS["additive"](), tiers=tiers, m=m)
+            agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+            recipient.upload_aggregation(agg)
+
+        for tiers, m in [
+            (2, None),  # knobs must travel together
+            (None, 2),
+            (1, 2),  # flat is spelled as absence, not tiers=1
+            (tiers_mod.MAX_TIERS + 1, 2),
+            (2, 1),  # a single sub-cohort is not a hierarchy
+            (2, tiers_mod.MAX_SUB_COHORTS + 1),
+        ]:
+            with pytest.raises(InvalidRequestError):
+                submit(tiers, m)
+        submit(2, 2)  # the minimal valid hierarchy is accepted
+
+
+# -- full rounds: tiered == flat, byte for byte ------------------------------
+
+VALUES = [[i + 1, (2 * i) % 7, 5, (3 * i + 2) % 11] for i in range(5)]
+
+
+def _provision_pool(tmp_path, service, n):
+    pool = [new_client(tmp_path / f"clerk{i}", service) for i in range(n)]
+    for c in pool:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    return pool
+
+
+def _flat_round(tmp_path, service, sharing, values, tag="flat"):
+    recipient = new_client(tmp_path / f"{tag}-r", service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _aggregation(sharing)
+    agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+    recipient.upload_aggregation(agg)
+    pool = _provision_pool(tmp_path / tag, service, sharing.output_size)
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in pool])
+    for i, v in enumerate(values):
+        p = new_client(tmp_path / f"{tag}-p{i}", service)
+        p.upload_agent()
+        p.participate(v, agg.id)
+    recipient.end_aggregation(agg.id)
+    run_committee(pool, -1)
+    return recipient.reveal_aggregation(agg.id).positive()
+
+
+def _tiered_round(tmp_path, service, sharing, values, tiers, m, tag="tiered"):
+    recipient = new_client(tmp_path / f"{tag}-r", service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    agg = _aggregation(sharing, tiers=tiers, m=m)
+    agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+    pool = _provision_pool(tmp_path / tag, service, sharing.output_size)
+
+    def new_promoter(name):
+        return new_client(tmp_path / f"{tag}-{name}", service)
+
+    round = setup_tier_round(recipient, agg, new_promoter, pool)
+    participants = []
+    for i, v in enumerate(values):
+        p = new_client(tmp_path / f"{tag}-p{i}", service)
+        p.upload_agent()
+        p.participate(v, agg.id)
+        participants.append(p)
+    result = run_tier_round(round)
+    assert result.skipped == []
+    return agg, round, participants, result.output.positive()
+
+
+@pytest.mark.parametrize("m", [1, 2, 4])
+@pytest.mark.parametrize("scheme", sorted(SHARINGS))
+def test_tiered_reveal_matches_flat_bytes(scheme, m, tmp_path):
+    """The exactness matrix: for every sharing scheme, the 2-tier round
+    at fan-out m reveals byte-identically to the flat round over the same
+    values (m=1 is the flat control against the plain modular sum)."""
+    expected = np.array(
+        [sum(v[d] for v in VALUES) % MODULUS for d in range(DIM)], dtype=np.int64
+    )
+    with with_service() as ctx:
+        flat = _flat_round(tmp_path, ctx.service, SHARINGS[scheme](), VALUES)
+        assert flat.values.tobytes() == expected.tobytes()
+        if m == 1:
+            return
+        _, _, _, tiered = _tiered_round(
+            tmp_path, ctx.service, SHARINGS[scheme](), VALUES, tiers=2, m=m
+        )
+        assert tiered.values.tobytes() == flat.values.tobytes()
+        assert tiered.modulus == flat.modulus
+
+
+def test_three_tier_round_exact(tmp_path):
+    """Depth recursion: tiers=3, m=2 — 7 committees, promotions climbing
+    two levels — still the exact flat sum."""
+    expected = np.array(
+        [sum(v[d] for v in VALUES) % MODULUS for d in range(DIM)], dtype=np.int64
+    )
+    with with_service() as ctx:
+        _, _, _, out = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=3, m=2
+        )
+        assert out.values.tobytes() == expected.tobytes()
+
+
+def test_participations_route_to_leaves_and_promotions_to_root(tmp_path):
+    with with_service() as ctx:
+        agg, round, participants, _ = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=2, m=2
+        )
+        status = ctx.service.get_tier_status(round.recipient.agent, agg.id)
+        assert status is not None and status.tiers == 2 and status.sub_cohort_size == 2
+        by_id = {n.aggregation: n for n in status.nodes}
+        assert [n.tier for n in status.nodes] == [0, 1, 1]
+        # every real participation landed on the leaf its id hashes to
+        for p in participants:
+            leaf = tiers_mod.leaf_aggregation_id(agg, p.agent.id)
+            assert by_id[leaf].tier == 1
+        leaf_counts = [n.number_of_participations for n in status.nodes if n.tier == 1]
+        assert sum(leaf_counts) == len(participants)
+        # the root holds exactly one promotion per sub-committee
+        root = by_id[agg.id]
+        assert root.number_of_participations == 2
+        assert root.result_ready and all(n.result_ready for n in status.nodes)
+
+
+def test_tier_status_unprovisioned_and_flat(tmp_path):
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        # flat aggregations have no tier status
+        flat = _aggregation(SHARINGS["additive"]())
+        flat.recipient, flat.recipient_key = recipient.agent.id, rkey
+        recipient.upload_aggregation(flat)
+        assert ctx.service.get_tier_status(recipient.agent, flat.id) is None
+        # a tiered root uploaded without provisioning reports its derived
+        # children as not-yet-existing
+        agg = _aggregation(SHARINGS["additive"](), tiers=2, m=4)
+        agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+        recipient.upload_aggregation(agg)
+        status = ctx.service.get_tier_status(recipient.agent, agg.id)
+        assert len(status.nodes) == 5
+        assert status.nodes[0].exists
+        assert all(not n.exists for n in status.nodes[1:])
+
+
+def test_delete_cascades_over_derived_tree(tmp_path):
+    with with_service() as ctx:
+        agg, round, _, _ = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=2, m=2
+        )
+        children = [tn.aggregation.id for tn in round.nodes if tn.node.parent]
+        for child in children:
+            assert ctx.service.get_aggregation(round.recipient.agent, child) is not None
+        round.recipient.delete_aggregation(agg.id)
+        assert ctx.service.get_aggregation(round.recipient.agent, agg.id) is None
+        for child in children:
+            assert ctx.service.get_aggregation(round.recipient.agent, child) is None
+
+
+def test_vanished_sub_cohort_survival(tmp_path):
+    """Lose one whole sub-aggregation after ingest: strict=False skips it
+    and the root reveals the EXACT sum of the surviving sub-cohorts."""
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        agg = _aggregation(SHARINGS["additive"](), tiers=2, m=2)
+        agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+        pool = _provision_pool(tmp_path / "pool", ctx.service, 3)
+        round = setup_tier_round(
+            recipient, agg, lambda name: new_client(tmp_path / name, ctx.service), pool
+        )
+        by_leaf: dict = {}
+        for i, v in enumerate(VALUES):
+            p = new_client(tmp_path / f"p{i}", ctx.service)
+            p.upload_agent()
+            p.participate(v, agg.id)
+            by_leaf.setdefault(
+                tiers_mod.leaf_aggregation_id(agg, p.agent.id), []
+            ).append(v)
+        assert len(by_leaf) == 2, "hash split should populate both sub-cohorts"
+        lost = round.nodes[1]
+        lost.owner.delete_aggregation(lost.aggregation.id)
+        result = run_tier_round(round, strict=False)
+        assert result.skipped == [lost.aggregation.id]
+        survivors = [
+            v
+            for leaf, vals in by_leaf.items()
+            if leaf != lost.aggregation.id
+            for v in vals
+        ]
+        expected = [sum(v[d] for v in survivors) % MODULUS for d in range(DIM)]
+        assert list(result.output.positive().values) == expected
+        # the same failure under strict=True is loud
+        with pytest.raises(Exception):
+            run_tier_round(round, strict=True)
+
+
+def test_promotions_counted(tmp_path):
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with with_service() as ctx:
+            _tiered_round(
+                tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=2, m=2
+            )
+            counters = telemetry.snapshot(include_spans=0)["counters"]
+            promoted = sum(
+                c["value"]
+                for c in counters
+                if c["name"] == "sda_tier_promotions_total"
+            )
+            # one promotion per sub-committee (REST cells run the server
+            # in-process, so the counter is visible either way)
+            assert promoted == 2, counters
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(was)
+
+
+def test_tiered_round_over_sharded_store(tmp_path):
+    """The hierarchical plane composes with the sharded coordination
+    plane: a 2-tier round over K=2 partitions reveals the exact sum."""
+    from sda_tpu.server import new_sharded_server
+
+    service = new_sharded_server("mem", 2)
+    expected = np.array(
+        [sum(v[d] for v in VALUES) % MODULUS for d in range(DIM)], dtype=np.int64
+    )
+    _, _, _, out = _tiered_round(
+        tmp_path, service, SHARINGS["additive"](), VALUES, tiers=2, m=2
+    )
+    assert out.values.tobytes() == expected.tobytes()
