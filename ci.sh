@@ -20,7 +20,11 @@
 #      (request/crypto/store/engine) is present with the run's trace id
 #      visible in server-side spans; then a ~20s load_soak.py smoke whose
 #      banked artifact (exact rounds + monotonic sampler series) must
-#      render through scripts/trace_report.py
+#      render through scripts/trace_report.py; then the flagship smoke
+#      (scripts/flagship.py --smoke): a tiny certified-cohort ladder
+#      over 2 sdad OS processes x 2 shards x R=2 whose artifact must
+#      certify at least the first rung and carry a merged cross-process
+#      telemetry series that actually saw both frontends
 #   4. examples/ — both runnable end-to-end demos (federated training,
 #      federated analytics) must keep running as documented
 #   5. scripts/scenarios.py — churn-scenario smoke over the real REST
@@ -29,8 +33,11 @@
 #      and saturated-frontend (429 storm under a pinned admission cap);
 #      banked artifacts must record byte-exact reveals
 #   6. scripts/bench_compare.py — throughput gate over banked bench
-#      artifacts (newest vs previous per rider family); advisory unless
-#      SDA_BENCH_GATE=1
+#      artifacts (newest vs previous per rider family); the distributed
+#      planes (shard/tier/replication/flagship + soak variants) fail the
+#      build on regression, the single-process riders are advisory;
+#      SDA_BENCH_GATE=1 hard-gates everything, SDA_BENCH_GATE=0 demotes
+#      the whole stage to advisory
 set -e
 cd "$(dirname "$0")"
 
@@ -92,6 +99,37 @@ EOF
 JAX_PLATFORMS=cpu python scripts/trace_report.py "$SOAK_ART"/soak-*.json
 rm -rf "$SOAK_ART"
 
+echo "=== ci 3c/6: flagship smoke (tiers x shards x replicas, 2 OS processes) ==="
+# ~30 s certified-cohort ladder over 2 sdad frontend processes sharing a
+# 2-shard R=2 store, sub-committees clerking as separate daemons: every
+# certified rung is byte-identical to a flat single-committee baseline.
+# The artifact must certify at least the opening rung and its merged
+# /v1/metrics series must prove the telemetry really spanned processes
+# (some bucket saw >= 2 frontends) — a single-process series passing
+# silently here would unwind the whole cross-process claim.
+FLAG_ART="$(mktemp -d)"
+JAX_PLATFORMS=cpu python scripts/flagship.py --smoke --artifacts "$FLAG_ART"
+python - "$FLAG_ART" <<'EOF'
+import json, pathlib, sys
+arts = sorted(pathlib.Path(sys.argv[1]).glob("flagship-*.json"))
+assert len(arts) == 1, f"expected one flagship artifact, found {arts}"
+d = json.loads(arts[0].read_text())
+assert d["topology"]["frontend_processes"] >= 2, d["topology"]
+assert d["topology"]["shards"] >= 2 and d["topology"]["replicas"] >= 2
+assert d["certified_max_cohort"] >= 4, \
+    f"smoke ladder certified nothing: {d['certified_max_cohort']}"
+assert all(r["exact"] and r["flat_byte_match"]
+           for r in d["ladder"] if r.get("certified")), \
+    "a certified rung was not byte-identical to the flat baseline"
+merged = d.get("merged_samples") or []
+assert merged, "no merged cross-process telemetry series banked"
+peak = max(s.get("procs", 0) for s in merged)
+assert peak >= 2, f"merged series never saw both frontends (peak {peak})"
+print(f"ci: flagship certified cohort {d['certified_max_cohort']} "
+      f"({len(merged)} merged buckets, peak {peak} procs)")
+EOF
+rm -rf "$FLAG_ART"
+
 echo "=== ci 4/6: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null
 python examples/federated_analytics.py >/dev/null
@@ -151,16 +189,26 @@ EOF
 rm -rf "$SCEN_ART"
 
 echo "=== ci 6/6: bench throughput gate (newest vs previous artifacts) ==="
-# advisory by default: compare the two newest banked artifacts per rider
-# family and report any throughput drop beyond the threshold; export
-# SDA_BENCH_GATE=1 to make a regression fail the build
-if python scripts/bench_compare.py bench-artifacts; then
-    :
-elif [ "${SDA_BENCH_GATE:-0}" = "1" ]; then
-    echo "ci: bench throughput regressed and SDA_BENCH_GATE=1 — failing" >&2
-    exit 1
+# the distributed-plane families hard-gate by default: a throughput
+# regression in shard/tier/replication/flagship (or their soak variants)
+# fails the build, while the single-process riders stay advisory.
+# SDA_BENCH_GATE=1 promotes every family to hard-gating;
+# SDA_BENCH_GATE=0 demotes the whole stage back to advisory.
+HARD_FAMILIES="shard,tier,replication,replica-soak,grow-soak,flagship"
+if [ "${SDA_BENCH_GATE:-}" = "1" ]; then
+    if ! python scripts/bench_compare.py bench-artifacts; then
+        echo "ci: bench throughput regressed and SDA_BENCH_GATE=1 — failing" >&2
+        exit 1
+    fi
+elif [ "${SDA_BENCH_GATE:-}" = "0" ]; then
+    python scripts/bench_compare.py bench-artifacts \
+        || echo "ci: bench throughput regression reported (advisory; SDA_BENCH_GATE=0)" >&2
 else
-    echo "ci: bench throughput regression reported (advisory; set SDA_BENCH_GATE=1 to enforce)" >&2
+    if ! python scripts/bench_compare.py bench-artifacts --gate "$HARD_FAMILIES"; then
+        echo "ci: distributed-plane throughput regressed ($HARD_FAMILIES) — failing" >&2
+        echo "ci: set SDA_BENCH_GATE=0 to demote this gate to advisory" >&2
+        exit 1
+    fi
 fi
 
 echo "=== ci: all gates passed ==="

@@ -17,8 +17,16 @@ fail the gate; latency/RSS columns are deliberately out of scope (they
 live in sweep_report.py) — this gate is throughput-only so a slower-but-
 correct change can't hide behind an unrelated column.
 
+``--gate`` narrows which families can flip the exit code: regressions in
+families outside the list are still printed (marked ``advisory``) but do
+not fail the run. The default gates every family; ci.sh uses this to
+hard-gate the distributed-plane families (shard/tier/replication/
+flagship and the soak variants) while keeping the single-process riders
+advisory.
+
 Usage:
   python scripts/bench_compare.py [artifacts-dir] [--threshold 15]
+      [--gate shard,tier,replication]
 """
 
 from __future__ import annotations
@@ -118,6 +126,27 @@ def _metrics_soak(d: dict) -> dict:
     return out
 
 
+def _metrics_flagship(d: dict) -> dict:
+    """flagship-*: the certified-cohort headline plus the fastest
+    certified rung's phones-per-second. Both higher-is-better, so the
+    generic delta logic applies: a ladder that stops certifying earlier,
+    or certifies the same rung slower, reads as a regression."""
+    out = {}
+    if isinstance(d.get("certified_max_cohort"), (int, float)) \
+            and d["certified_max_cohort"] > 0:
+        out["certified_max_cohort"] = float(d["certified_max_cohort"])
+    ladder = d.get("ladder") if isinstance(d.get("ladder"), list) else []
+    rates = [
+        r["cohort"] / r["round_s"] for r in ladder
+        if isinstance(r, dict) and r.get("certified")
+        and isinstance(r.get("cohort"), (int, float))
+        and isinstance(r.get("round_s"), (int, float)) and r["round_s"] > 0
+    ]
+    if rates:
+        out["peak_cohort_per_s"] = float(max(rates))
+    return out
+
+
 #: family -> (glob, throughput extractor); sorted() over the stamped
 #: names is chronological, so [-1] is newest and [-2] its predecessor
 RIDERS = {
@@ -128,11 +157,13 @@ RIDERS = {
     "wire": ("wire-*.json", _metrics_wire),
     "soak": ("soak-*.json", _metrics_soak),
     "shard": ("shard-*.json", _metrics_shard),
-    # pathlib globs match the whole name, so soak-*/replica-soak-* and
-    # shard-*/replication-* never cross-pollinate
+    # pathlib globs match the whole name, so soak-*/replica-soak-*/
+    # grow-soak-* and shard-*/replication-* never cross-pollinate
     "replica-soak": ("replica-soak-*.json", _metrics_soak),
+    "grow-soak": ("grow-soak-*.json", _metrics_soak),
     "replication": ("replication-*.json", _metrics_shard),
     "tier": ("tier-*.json", _metrics_tier),
+    "flagship": ("flagship-*.json", _metrics_flagship),
 }
 
 
@@ -186,10 +217,22 @@ def main() -> int:
     ap.add_argument("artdir", nargs="?", default="bench-artifacts")
     ap.add_argument("--threshold", type=float, default=15.0,
                     help="max tolerated throughput drop, percent (default 15)")
+    ap.add_argument("--gate", default="all", metavar="FAM[,FAM...]",
+                    help="comma-separated families whose regressions fail "
+                         "the run; others become advisory (default: all)")
     args = ap.parse_args()
     artdir = pathlib.Path(args.artdir)
+    if args.gate == "all":
+        gated = set(RIDERS)
+    else:
+        gated = {f.strip() for f in args.gate.split(",") if f.strip()}
+        unknown = gated - set(RIDERS)
+        if unknown:
+            ap.error(f"unknown --gate families: {', '.join(sorted(unknown))} "
+                     f"(known: {', '.join(RIDERS)})")
 
     regressions = 0
+    advisory = 0
     compared = 0
     print(f"throughput gate: newest vs previous, threshold -{args.threshold:g}%")
     for family in RIDERS:
@@ -200,23 +243,34 @@ def main() -> int:
             print(f"\n{family}: n/a (fewer than two comparable artifacts)")
             continue
         compared += 1
-        print(f"\n{family}: {prev_name} -> {new_name}")
+        hard = family in gated
+        print(f"\n{family}: {prev_name} -> {new_name}"
+              + ("" if hard else "  (advisory)"))
         print(f"  {'metric':<28} {'prev':>12} {'new':>12} {'delta%':>8}")
         for r in rows:
-            flag = "  REGRESSED" if r["regressed"] else ""
+            flag = ("  REGRESSED" if hard else "  regressed (advisory)") \
+                if r["regressed"] else ""
             print(f"  {r['metric']:<28} {r['prev']:>12.3f} {r['new']:>12.3f} "
                   f"{r['delta_pct']:>+8.2f}{flag}")
-            regressions += r["regressed"]
+            if r["regressed"]:
+                if hard:
+                    regressions += 1
+                else:
+                    advisory += 1
 
     if not compared:
         print(f"\nnothing to compare under {artdir}/ "
               f"(need two artifacts of some family)", file=sys.stderr)
         return 0  # an empty bench dir is not a regression
+    if advisory:
+        print(f"\n{advisory} metric(s) regressed in advisory (ungated) "
+              f"families", file=sys.stderr)
     if regressions:
         print(f"\n{regressions} metric(s) regressed more than "
               f"{args.threshold:g}%", file=sys.stderr)
         return 1
-    print("\nno throughput regressions beyond threshold")
+    print("\nno throughput regressions beyond threshold"
+          + (" in gated families" if advisory else ""))
     return 0
 
 

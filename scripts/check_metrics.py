@@ -15,7 +15,11 @@ Prometheus scraper would and checks:
    sda_engine_step_seconds, — via a paged clerking round —
    sda_clerk_stage_seconds and sda_clerk_overlap_efficiency, and — via a
    paged reveal — sda_reveal_stage_seconds and
-   sda_reveal_overlap_efficiency.
+   sda_reveal_overlap_efficiency;
+3. the merged cross-process series assembles: a second real ``sdad``
+   daemon is spawned and both processes' /v1/metrics/history bodies must
+   merge (merge_histories) into a bucket with ``procs >= 2`` — the
+   fleet view the flagship campaign banks.
 
 Run by ci.sh after the CLI walkthrough: JAX_PLATFORMS=cpu python
 scripts/check_metrics.py. Exit 0 on pass, 1 with a diagnostic on fail.
@@ -316,6 +320,66 @@ def check_observability_routes(base_url: str) -> list:
     return errors
 
 
+def check_merged_history(base_url: str) -> list:
+    """The flagship plane assembles its fleet view by merging per-process
+    ``/v1/metrics/history`` bodies (telemetry.timeseries.merge_histories).
+    Gate the merge over two REAL processes — this process's live sampler
+    plus a genuinely separate ``sdad httpd`` daemon, both scraped over
+    HTTP: the merged series must contain a bucket both contributed to,
+    or the campaign artifact's cross-process claim is hollow."""
+    import json
+    import subprocess
+    import time
+
+    from sda_tpu.telemetry.timeseries import merge_histories
+
+    errors = []
+    env = dict(os.environ, SDA_TS_INTERVAL_S="0.2", SDA_TELEMETRY="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sda_tpu.cli.sdad", "--mem",
+         "httpd", "-b", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"listening on ([\d.]+):(\d+)", line or "")
+        if not m:
+            return [f"peer sdad never announced its port (got {line!r})"]
+        peer = f"http://{m.group(1)}:{m.group(2)}"
+
+        def history(url):
+            with urllib.request.urlopen(
+                f"{url}/v1/metrics/history", timeout=30
+            ) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+
+        deadline = time.monotonic() + 15.0
+        peak = 0
+        while time.monotonic() < deadline:
+            # keep both samplers fed so their windows are non-empty
+            urllib.request.urlopen(f"{peer}/v1/healthz", timeout=30).read()
+            urllib.request.urlopen(f"{base_url}/v1/healthz", timeout=30).read()
+            merged = merge_histories([history(base_url), history(peer)])
+            peak = max([peak] + [s.get("procs", 0) for s in merged])
+            if peak >= 2:
+                break
+            time.sleep(0.2)
+        if peak < 2:
+            errors.append(
+                "merged /v1/metrics/history series never saw both "
+                f"processes within 15s (peak procs {peak})"
+            )
+    except Exception as e:
+        errors.append(f"merged cross-process history check failed: {e}")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    return errors
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # a sub-second sampler interval so at least one time-series window is
@@ -337,6 +401,7 @@ def main() -> int:
         drive_faulted_leg(base_url, tmp)
         drive_engine()
         observability_errors = check_observability_routes(base_url)
+        observability_errors += check_merged_history(base_url)
         with urllib.request.urlopen(f"{base_url}/v1/metrics", timeout=30) as resp:
             content_type = resp.headers.get("Content-Type", "")
             body = resp.read().decode("utf-8")

@@ -1,0 +1,523 @@
+"""Flagship campaign: tiers x shards x replicas as one elastic topology.
+
+The composition run ROADMAP item 1 asks for: a tiered aggregation
+(T tiers, fan-out m) driven over a REAL distributed deployment — N
+separate ``sdad httpd`` OS processes fronting one sharded (K) +
+replicated (R) store plane — with every sub-committee clerking as its
+own ``sdad committee`` daemon process, coordinating purely over the
+REST wire. No in-process shortcuts anywhere on the data path: the
+driver only provisions, paces participants, and polls.
+
+Placement is coordinator-free: ``protocol.tiers.tier_placement`` stamps
+every tier node with a deterministic frontend index (pure function of
+the node's aggregation id and the frontend count), the multi-root
+client routes each node's traffic to the same index, and this script
+asserts the two agree for every node of every rung.
+
+The campaign models a million-phone population compressed onto one
+host: participants arrive on a deterministic trace
+(:mod:`sda_tpu.utils.arrivals` — diurnal ramp, bursts, churned
+stragglers), and the cohort DOUBLES each rung until a rung misses the
+deadline or the wall budget runs out. The headline is
+``certified_max_cohort``: the largest real cohort whose tiered round
+over the full topology revealed byte-identically to a flat
+single-process baseline over the same values, within the rung
+deadline. The artifact is honest about scale: ``multi_core_host:
+false`` (everything shares one host's cores) and the 1M figure is the
+``simulated_population`` the trace models, not the certified cohort.
+
+Per-frontend ``/v1/metrics/history`` windows are scraped at the end and
+folded into one fleet series (``telemetry.timeseries.merge_histories``)
+so the longitudinal evidence spans all N processes.
+
+Banks ``flagship-<stamp>.json`` (bench_compare.py gates the family;
+sweep_report.py renders the ladder).
+
+Usage:
+  python scripts/flagship.py                  # the full local flagship
+  python scripts/flagship.py --smoke          # ~30s CI shape (2x2, tiny ladder)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import numpy as np  # noqa: E402
+
+DIM = 4
+MODULUS = 100003
+
+
+# -- process plane -----------------------------------------------------------
+
+
+def spawn_frontend(tmp: pathlib.Path, ix: int, store_root: pathlib.Path,
+                   shards: int, replicas: int) -> tuple:
+    """One ``sdad httpd`` OS process over the SHARED file-store root on a
+    kernel-picked port; returns (proc, base_url). All N frontends build
+    the same pure ring over the same partition layout, so any of them
+    can serve any key — the client's placement just decides which one
+    usually does."""
+    errlog = open(tmp / f"frontend-{ix}.stderr", "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sda_tpu.cli.sdad",
+         "--file", str(store_root),
+         "--shards", str(shards), "--replicas", str(replicas),
+         "httpd", "-b", "127.0.0.1:0"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=errlog, text=True,
+    )
+    proc._sda_errlog_path = errlog.name  # failure-diagnostics hook
+    errlog.close()
+    from test_shared_store import _bound_port, _wait_ready
+
+    port = _bound_port(proc)
+    _wait_ready(port, proc)
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def spawn_committee(tmp: pathlib.Path, tag: str, identity_dirs: list,
+                    roots: list) -> subprocess.Popen:
+    """One sub-committee as its own ``sdad committee`` daemon process:
+    it loads the clerk identities from disk and polls every frontend
+    root (repeatable ``-s``, ring-routed exactly like the driver's
+    multi-root client)."""
+    cmd = [sys.executable, "-m", "sda_tpu.cli.sdad", "committee", "-p", "0.2"]
+    for u in roots:
+        cmd += ["-s", u]
+    for d in identity_dirs:
+        cmd += ["-i", str(d)]
+    errlog = open(tmp / f"committee-{tag}.stderr", "w")
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.DEVNULL,
+                            stderr=errlog, text=True)
+    errlog.close()
+    return proc
+
+
+def multi_root_client(tmp: pathlib.Path, name: str, roots: list):
+    """Disk-persistent identity over the multi-root REST client — the
+    same layout the committee daemons load."""
+    from scenarios import persistent_client
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+
+    identity = tmp / f"id-{name}"
+    service = SdaHttpClient(roots, TokenStore(str(identity)))
+    return persistent_client(identity, service)
+
+
+def stop(procs: list) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+# -- rounds ------------------------------------------------------------------
+
+
+def rung_values(rung: int, cohort: int) -> list:
+    return [[(rung + i) % 11, i % 7, 1, (3 * i) % 5] for i in range(cohort)]
+
+
+def flat_baseline(values: list) -> bytes:
+    """The flat single-process control: the same values through the
+    plain pipeline on an in-process mem server; returns the revealed
+    vector's bytes — the byte-identity target for the distributed
+    tiered reveal."""
+    from sda_tpu.client import SdaClient, run_committee
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.server import new_mem_server
+
+    service = new_mem_server()
+    with tempfile.TemporaryDirectory() as td:
+        tmp = pathlib.Path(td)
+
+        def new_client(name):
+            ks = Keystore(str(tmp / name))
+            return SdaClient(SdaClient.new_agent(ks), ks, service)
+
+        recipient = new_client("r")
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(f"c{i}") for i in range(2)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title="flagship-flat-baseline",
+            vector_dimension=DIM,
+            modulus=MODULUS,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=ChaChaMasking(
+                modulus=MODULUS, dimension=DIM, seed_bitsize=128
+            ),
+            committee_sharing_scheme=AdditiveSharing(
+                share_count=2, modulus=MODULUS
+            ),
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        participant = new_client("p")
+        participant.upload_agent()
+        participant.upload_participations(
+            participant.new_participations(values, agg.id)
+        )
+        recipient.end_aggregation(agg.id)
+        run_committee(clerks, -1)
+        return recipient.reveal_aggregation(agg.id).positive().values.tobytes()
+
+
+def tiered_aggregation(recipient, rkey, tiers: int, m: int, tag: str):
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+
+    return Aggregation(
+        id=AggregationId.random(),
+        title=f"flagship-{tag}",
+        vector_dimension=DIM,
+        modulus=MODULUS,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(
+            modulus=MODULUS, dimension=DIM, seed_bitsize=128
+        ),
+        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=MODULUS),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+        sub_cohort_size=m,
+        tiers=tiers,
+    )
+
+
+def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
+    """One ladder rung: provision a fresh tiered tree over the live
+    plane, pace the cohort in on the arrival trace, run the round with
+    EXTERNAL committees (the daemons), reveal, and hold the reveal
+    byte-identical to the flat baseline over the same values."""
+    from sda_tpu.client import run_tier_round, setup_tier_round
+
+    t0 = time.perf_counter()
+    tmp, roots = ctx["tmp"], ctx["roots"]
+    recipient, rkey = ctx["recipient"], ctx["rkey"]
+    trace, cursor = ctx["trace"], ctx["cursor"]
+
+    agg = tiered_aggregation(recipient, rkey, ctx["tiers"], ctx["fanout"],
+                             f"rung{rung}")
+
+    def new_promoter(name):
+        return multi_root_client(tmp, f"rung{rung}-{name}", roots)
+
+    tround = setup_tier_round(
+        recipient, agg, new_promoter, ctx["pool"],
+        disjoint_committees=True, frontends=len(roots),
+    )
+    # placement is honored end to end: every node's stamped frontend is
+    # exactly where the multi-root client homes that node's traffic
+    for tn in tround.nodes:
+        assert tn.frontend == recipient.service.route_index(tn.aggregation.id), (
+            f"placement disagrees for node {tn.aggregation.id}"
+        )
+
+    values = rung_values(rung, cohort)
+    # the cohort arrives on the trace: each upload waits for its arrival
+    # time; churned phones disconnect and retry at the end of the round
+    deferred = []
+    participants = ctx["participants"]
+    for i, v in enumerate(values):
+        k = cursor["index"]
+        cursor["index"] = k + 1
+        cursor["t"] = trace.next_arrival(k, cursor["t"])
+        delay = cursor["t0"] + cursor["t"] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        p = participants[i % len(participants)]
+        part = p.new_participations([v], agg.id)[0]
+        if trace.is_churned(k):
+            deferred.append((p, part))
+            continue
+        p.service.create_participation(p.agent, part)
+    for p, part in deferred:
+        p.service.create_participation(p.agent, part)
+
+    result = run_tier_round(
+        tround, external_clerks=True, poll_interval=0.1,
+        poll_timeout=ctx["poll_timeout"],
+    )
+    out = result.output.positive()
+    expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
+    exact = [int(x) for x in out.values] == expected
+    flat = flat_baseline(values)
+    flat_match = out.values.tobytes() == flat
+    elapsed = time.perf_counter() - t0
+    return {
+        "rung": rung,
+        "cohort": cohort,
+        "churned": len(deferred),
+        "committees": len(tround.nodes),
+        "round_s": round(elapsed, 2),
+        "exact": exact,
+        "flat_byte_match": flat_match,
+        "aggregate": [int(x) for x in out.values],
+        "skipped": [str(s) for s in result.skipped],
+        "placement": {
+            str(tn.aggregation.id): tn.frontend for tn in tround.nodes
+        },
+        "_elapsed": elapsed,
+    }
+
+
+# -- merged fleet telemetry --------------------------------------------------
+
+
+def scrape_fleet(roots: list) -> dict:
+    """Every frontend's /v1/metrics/history folded into one series."""
+    import requests
+
+    from sda_tpu.telemetry.timeseries import merge_histories
+
+    histories = []
+    for u in roots:
+        try:
+            histories.append(
+                requests.get(f"{u}/v1/metrics/history", timeout=10).json()
+            )
+        except Exception:
+            histories.append({"samples": []})
+    merged = merge_histories(histories)
+    per_proc = [len(h.get("samples", [])) for h in histories]
+    return {
+        "frontends_scraped": len(roots),
+        "samples_per_frontend": per_proc,
+        "merged_buckets": len(merged),
+        "max_procs_in_bucket": max((b["procs"] for b in merged), default=0),
+        "merged": merged,
+    }
+
+
+# -- campaign ----------------------------------------------------------------
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--frontends", type=int, default=3, metavar="N",
+                    help="sdad httpd OS processes (default 3)")
+    ap.add_argument("--shards", type=int, default=2, metavar="K")
+    ap.add_argument("--replicas", type=int, default=2, metavar="R")
+    ap.add_argument("--tiers", type=int, default=2, metavar="T")
+    ap.add_argument("--fanout", type=int, default=4, metavar="M",
+                    help="sub-cohorts per node (default 4)")
+    ap.add_argument("--trace",
+                    default="base=200,diurnal=0.6@20,burst=0.15@4,churn=0.1:16",
+                    help="arrival trace spec (sda_tpu.utils.arrivals)")
+    ap.add_argument("--cohort-start", type=int, default=8)
+    ap.add_argument("--rung-deadline", type=float, default=90.0,
+                    help="a rung slower than this fails certification")
+    ap.add_argument("--budget-s", type=float, default=240.0,
+                    help="wall budget for the whole ladder")
+    ap.add_argument("--max-cohort", type=int, default=512)
+    ap.add_argument("--simulated-population", type=int, default=1_000_000)
+    ap.add_argument("--participant-identities", type=int, default=16,
+                    help="distinct registered phone identities the cohort "
+                         "cycles through (leaf assignment hashes the "
+                         "identity, so this bounds leaf diversity)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the ~30s CI shape: 2 frontends, 2 shards, "
+                         "ladder capped at 2 rungs")
+    ap.add_argument("--artifacts", default=str(REPO / "bench-artifacts"))
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.frontends = 2
+        args.shards = 2
+        args.tiers = 2
+        args.fanout = 4
+        args.cohort_start = 4
+        args.max_cohort = 8
+        args.budget_s = 120.0
+        args.trace = "base=300,burst=0.2@3,churn=0.1:16"
+
+    # the frontends sample their own registries; a 1s window makes even
+    # the smoke run bank several samples per process
+    env_ts = os.environ.setdefault("SDA_TS_INTERVAL_S", "1")
+    os.environ.setdefault("SDA_TELEMETRY", "1")
+    del env_ts
+
+    from sda_tpu.utils.arrivals import ArrivalTrace
+
+    t_start = time.perf_counter()
+    procs: list = []
+    record: dict = {
+        "kind": "flagship",
+        "topology": {
+            "frontend_processes": args.frontends,
+            "shards": args.shards,
+            "replicas": args.replicas,
+            "tiers": args.tiers,
+            "fanout": args.fanout,
+            "multi_core_host": False,
+        },
+        "trace": args.trace,
+        "simulated_population": args.simulated_population,
+    }
+    with tempfile.TemporaryDirectory() as td:
+        tmp = pathlib.Path(td)
+        store_root = tmp / "store"
+        try:
+            roots = []
+            for ix in range(args.frontends):
+                proc, url = spawn_frontend(
+                    tmp, ix, store_root, args.shards, args.replicas
+                )
+                procs.append(proc)
+                roots.append(url)
+            print(f"[flagship] {len(roots)} frontends up: {' '.join(roots)}",
+                  file=sys.stderr)
+
+            recipient = multi_root_client(tmp, "recipient", roots)
+            recipient.upload_agent()
+            rkey = recipient.new_encryption_key()
+            recipient.upload_encryption_key(rkey)
+
+            # disjoint committees: every tree node gets its own clerks,
+            # and every node's committee runs as its own OS process
+            n_nodes = sum(args.fanout**t for t in range(args.tiers))
+            share_count = 2
+            pool = []
+            for i in range(share_count * n_nodes):
+                c = multi_root_client(tmp, f"clerk{i}", roots)
+                c.upload_agent()
+                c.upload_encryption_key(c.new_encryption_key())
+                pool.append(c)
+            for node_ix in range(n_nodes):
+                ids = [tmp / f"id-clerk{node_ix * share_count + j}"
+                       for j in range(share_count)]
+                procs.append(spawn_committee(tmp, f"node{node_ix}", ids, roots))
+            print(f"[flagship] {n_nodes} committee daemons launched "
+                  f"({share_count} clerks each)", file=sys.stderr)
+
+            participants = []
+            for i in range(args.participant_identities):
+                p = multi_root_client(tmp, f"phone{i}", roots)
+                p.upload_agent()
+                participants.append(p)
+
+            ctx = {
+                "tmp": tmp, "roots": roots,
+                "recipient": recipient, "rkey": rkey,
+                "pool": pool, "participants": participants,
+                "tiers": args.tiers, "fanout": args.fanout,
+                "trace": ArrivalTrace.from_text(args.trace),
+                "cursor": {"index": 0, "t": 0.0, "t0": time.perf_counter()},
+                "poll_timeout": max(60.0, args.rung_deadline),
+            }
+
+            ladder: list = []
+            certified = 0
+            cohort, rung = args.cohort_start, 0
+            while cohort <= args.max_cohort:
+                if time.perf_counter() - t_start > args.budget_s:
+                    print(f"[flagship] wall budget spent before cohort "
+                          f"{cohort}; stopping ladder", file=sys.stderr)
+                    break
+                r = run_rung(rung, cohort, ctx)
+                elapsed = r.pop("_elapsed")
+                certified_rung = (
+                    r["exact"] and r["flat_byte_match"]
+                    and not r["skipped"] and elapsed <= args.rung_deadline
+                )
+                r["certified"] = certified_rung
+                ladder.append(r)
+                print(f"[flagship] rung {rung}: cohort {cohort} in "
+                      f"{r['round_s']}s exact={r['exact']} "
+                      f"flat_match={r['flat_byte_match']} "
+                      f"certified={certified_rung}", file=sys.stderr)
+                if not certified_rung:
+                    break
+                certified = cohort
+                cohort *= 2
+                rung += 1
+
+            record["ladder"] = ladder
+            record["certified_max_cohort"] = certified
+            record["scale_factor"] = (
+                round(args.simulated_population / certified, 1)
+                if certified else None
+            )
+            fleet = scrape_fleet(roots)
+            record["fleet_timeseries"] = {
+                k: v for k, v in fleet.items() if k != "merged"
+            }
+            # the full merged series, bounded like the soak banks it
+            record["merged_samples"] = fleet["merged"][-600:]
+        except BaseException:
+            # the tmp dir dies with this scope: surface every process's
+            # stderr tail before it does, or daemon deaths are invisible
+            for log in sorted(tmp.glob("*.stderr")):
+                tail = log.read_text().splitlines()[-15:]
+                if tail:
+                    print(f"--- {log.name} ---", file=sys.stderr)
+                    print("\n".join(tail), file=sys.stderr)
+            raise
+        finally:
+            stop(procs)
+
+    record["campaign_s"] = round(time.perf_counter() - t_start, 1)
+    artdir = pathlib.Path(args.artifacts)
+    artdir.mkdir(parents=True, exist_ok=True)
+    path = artdir / f"flagship-{time.strftime('%Y%m%d-%H%M%S')}.json"
+    path.write_text(json.dumps(record, indent=1, default=repr))
+
+    print(f"[flagship] certified_max_cohort={record['certified_max_cohort']} "
+          f"over {record['topology']['frontend_processes']} frontends x "
+          f"{record['topology']['shards']} shards (R="
+          f"{record['topology']['replicas']}), "
+          f"{record['fleet_timeseries']['merged_buckets']} merged buckets "
+          f"(max {record['fleet_timeseries']['max_procs_in_bucket']} procs) "
+          f"in {record['campaign_s']}s", file=sys.stderr)
+    print(path)
+
+    ok = (
+        record["certified_max_cohort"] >= args.cohort_start
+        and record["fleet_timeseries"]["merged_buckets"] >= 1
+        and record["fleet_timeseries"]["max_procs_in_bucket"] >= 2
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

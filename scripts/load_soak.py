@@ -136,7 +136,7 @@ def new_round_aggregation(recipient, rkey, clerks, tag: str):
 
 
 def run_round(ix: int, stack, round_size: int, rate: float | None,
-              submit_services=None, kill_router=None) -> dict:
+              submit_services=None, kill_router=None, trace_ctx=None) -> dict:
     """One full round; returns the per-round record. Raises on an
     inexact reveal — a soak that silently aggregates wrong numbers is
     worse than one that stops.
@@ -151,7 +151,15 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
     round's home store shard right after the aggregation opens and heals
     it once the reveal lands — ingest, snapshot, clerking, and reveal
     all ride the surviving replica while the victim's writes queue as
-    hints."""
+    hints.
+
+    ``trace_ctx`` (--trace runs) replaces the pinned-rate pacing with a
+    live arrival trace: ``{"trace": ArrivalTrace, "index": k, "t": last
+    trace time, "t0": perf_counter at soak start}``. The cursor persists
+    across rounds so the diurnal phase and burst slots run continuously
+    through the whole soak; churned arrivals are deferred to the end of
+    the round (the disconnect-and-retry flood) — they still land before
+    the snapshot, so every reveal stays exact."""
     import concurrent.futures
 
     from sda_tpu import telemetry
@@ -162,6 +170,7 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
 
     t_round0 = time.perf_counter()
     victim = None
+    churned = None
     try:
         with telemetry.trace(f"soak-round-{ix}") as trace_id:
             agg = new_round_aggregation(recipient, rkey, clerks, str(ix))
@@ -186,6 +195,29 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
                     for f in [pool.submit(drain, w)
                               for w in range(len(submit_services))]:
                         f.result()
+            elif trace_ctx is not None:
+                # live arrival trace: pace each phone to its trace time
+                # (absolute against the soak's t0, so a slow round never
+                # silently slows the offered process), defer churned
+                # arrivals to a retry flood at the end of the round
+                trace = trace_ctx["trace"]
+                deferred = []
+                for p in parts:
+                    k = trace_ctx["index"]
+                    trace_ctx["index"] = k + 1
+                    trace_ctx["t"] = trace.next_arrival(k, trace_ctx["t"])
+                    delay = trace_ctx["t0"] + trace_ctx["t"] - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    if trace.is_churned(k):
+                        deferred.append(p)
+                        continue
+                    with telemetry.span("ingest.upload", rows=1):
+                        participant.upload_participation(p)
+                for p in deferred:
+                    with telemetry.span("ingest.upload", rows=1):
+                        participant.upload_participation(p)
+                churned = len(deferred)
             else:
                 # pinned arrival: one submission per 1/rate seconds,
                 # absolute schedule (sleep to the slot, not after the
@@ -221,6 +253,7 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
         "round_s": round(time.perf_counter() - t_round0, 3),
         "exact": exact,
         "killed_shard": victim,
+        "churned": churned,
     }
 
 
@@ -348,6 +381,13 @@ def main() -> int:
                     help="soak length in seconds (default 60)")
     ap.add_argument("--rate", type=float, default=40.0,
                     help="pinned arrival rate, participations/s (default 40)")
+    ap.add_argument("--trace", default=None, metavar="SPEC",
+                    help="replace the pinned rate with a deterministic "
+                         "arrival trace (sda_tpu.utils.arrivals grammar: "
+                         "base=R[,diurnal=A@P][,burst=P@M][,churn=P][:seed]"
+                         ") — diurnal phase and burst slots run "
+                         "continuously across rounds; churned arrivals "
+                         "retry at the end of their round")
     ap.add_argument("--round-size", type=int, default=80,
                     help="participations per round (default 80)")
     ap.add_argument("--interval", type=float, default=2.0,
@@ -376,6 +416,13 @@ def main() -> int:
     ap.add_argument("--replicas", type=int, default=1, metavar="R",
                     help="replicate aggregation state over the first R "
                          "shards of the ring preference (default 1)")
+    ap.add_argument("--grow-shards", type=int, default=0, metavar="G",
+                    help="add G store shards live during the soak, one "
+                         "per odd-numbered round, each grow migrating in "
+                         "the background WHILE that round runs — every "
+                         "reveal must stay byte-exact across the resize "
+                         "and the handoff/migration queue must drain to "
+                         "zero (0 = off, the default)")
     ap.add_argument("--kill-shard", type=int, default=0, metavar="M",
                     help="wedge the round's home store shard for the "
                          "whole body of every M-th round, heal it after "
@@ -388,6 +435,10 @@ def main() -> int:
     if args.kill_shard > 0 and (args.shards < 2 or args.replicas < 2):
         ap.error("--kill-shard needs --shards >= 2 and --replicas >= 2 "
                  "(a single-home round cannot survive losing its shard)")
+    if args.grow_shards > 0 and args.kill_shard > 0:
+        ap.error("--grow-shards and --kill-shard are separate axes: a "
+                 "grow flip waits for the handoff queue to drain, which "
+                 "a wedged shard holds open forever")
 
     os.environ["SDA_TS_INTERVAL_S"] = str(args.interval)
     if args.max_inflight > 0:
@@ -425,12 +476,15 @@ def main() -> int:
             "shards": args.shards,
             "replicas": args.replicas,
             "kill_shard": args.kill_shard,
+            "grow_shards": args.grow_shards,
+            "trace": args.trace,
             "faults": os.environ.get("SDA_FAULTS"),
         },
     }
-    if args.shards > 1:
+    if args.shards > 1 or args.grow_shards > 0:
         from sda_tpu.server import new_sharded_server
 
+        # a grow axis needs the elastic router even from K=1
         server = new_sharded_server("mem", args.shards, replicas=args.replicas)
     else:
         server = new_mem_server()
@@ -466,6 +520,17 @@ def main() -> int:
 
         telemetry.reset()  # the soak window starts clean of A/B traffic
         sampler = timeseries.acquire()
+        trace_ctx = None
+        if args.trace:
+            from sda_tpu.utils.arrivals import ArrivalTrace
+
+            trace_ctx = {
+                "trace": ArrivalTrace.from_text(args.trace),
+                "index": 0,
+                "t": 0.0,
+                "t0": time.perf_counter(),
+            }
+        grows_done = 0
         try:
             rounds: list = []
             deadline = time.monotonic() + args.duration
@@ -475,10 +540,47 @@ def main() -> int:
                     args.kill_shard > 0
                     and ix % args.kill_shard == args.kill_shard - 1
                 )
+                grow_thread = grow_info = None
+                if (args.grow_shards > 0 and grows_done < args.grow_shards
+                        and ix % 2 == 1):
+                    # the grow — copy, handoff drain, ring flip — runs in
+                    # the background WHILE this round's traffic flows;
+                    # the round and the resize must not perturb each other
+                    import threading
+
+                    grow_info = {}
+
+                    def do_grow(info=grow_info):
+                        t0 = time.monotonic()
+                        try:
+                            info["to_shards"] = router.grow(timeout=60.0) + 1
+                            info["grow_s"] = round(time.monotonic() - t0, 3)
+                        except Exception as e:  # surfaced after the round
+                            info["error"] = f"{type(e).__name__}: {e}"
+
+                    grow_thread = threading.Thread(target=do_grow, daemon=True)
+                    grow_thread.start()
                 rounds.append(run_round(
                     ix, stack, args.round_size, args.rate, submit_services,
                     kill_router=router if kill else None,
+                    trace_ctx=trace_ctx,
                 ))
+                if grow_thread is not None:
+                    grow_thread.join(timeout=90.0)
+                    if grow_thread.is_alive():
+                        raise AssertionError(f"round {ix}: shard grow stuck")
+                    if "error" in grow_info:
+                        raise AssertionError(
+                            f"round {ix}: shard grow failed: "
+                            f"{grow_info['error']}"
+                        )
+                    if router.hint_depth() > 0:
+                        raise AssertionError(
+                            f"round {ix}: post-grow handoff queue at "
+                            f"{router.hint_depth()}"
+                        )
+                    grows_done += 1
+                    rounds[-1]["grow"] = grow_info
                 if kill:
                     # healed: the repair thread must replay every hint
                     # before the next round murders a different shard
@@ -497,6 +599,11 @@ def main() -> int:
                     f", shard {rounds[-1]['killed_shard']} killed+repaired"
                     if kill else ""
                 )
+                if rounds[-1].get("grow"):
+                    tag += (f", grew to {rounds[-1]['grow']['to_shards']} "
+                            f"shards in {rounds[-1]['grow']['grow_s']}s")
+                if rounds[-1].get("churned") is not None:
+                    tag += f", {rounds[-1]['churned']} churned"
                 print(f"[soak] round {ix}: {rounds[-1]['round_s']}s, "
                       f"arrival {rounds[-1]['rate_achieved']}/s, exact{tag}",
                       file=sys.stderr)
@@ -539,11 +646,16 @@ def main() -> int:
         1 for r in record["rounds"] if r.get("killed_shard") is not None
     )
 
+    record["grows_done"] = grows_done
+    record["final_shards"] = router.shards if router is not None else 1
+
     artdir = pathlib.Path(args.artifacts)
     artdir.mkdir(parents=True, exist_ok=True)
-    # the kill-shard axis banks its own artifact family (replica-soak-*)
-    # so bench_compare's plain soak-* rider stays an apples-to-apples set
-    family = "replica-soak" if args.kill_shard > 0 else "soak"
+    # the kill-shard and grow-shard axes bank their own artifact families
+    # (replica-soak-* / grow-soak-*) so bench_compare's plain soak-*
+    # rider stays an apples-to-apples set
+    family = ("grow-soak" if args.grow_shards > 0
+              else "replica-soak" if args.kill_shard > 0 else "soak")
     path = artdir / f"{family}-{time.strftime('%Y%m%d-%H%M%S')}.json"
     path.write_text(json.dumps(record, indent=1, default=repr))
 
@@ -565,6 +677,7 @@ def main() -> int:
         and record["readyz"]["ready"]
         and (record["sampler_ab"] is None or record["sampler_ab"]["ok"])
         and (args.kill_shard == 0 or record["killed_rounds"] >= 1)
+        and (args.grow_shards == 0 or record["grows_done"] >= 1)
     )
     return 0 if ok else 1
 

@@ -51,6 +51,15 @@ per clerk-second, and the honestly-reported single-core round wall —
 the evidence that hierarchical committees shrink the per-clerk bound
 even where one CPU serializes every committee.
 
+Also tabulates the sustained-soak rider artifacts (``soak-<stamp>.json``
+and the fault-axis variants ``replica-soak-*`` / ``grow-soak-*``, written
+by scripts/load_soak.py) and the flagship campaign artifacts
+(``flagship-<stamp>.json``, written by scripts/flagship.py): one row per
+campaign with the process/shard/replica topology, the certified-max-
+cohort headline and its implied scale factor against the simulated
+population, rungs certified vs attempted, the peak certified
+phones-per-second, and the merged cross-process telemetry coverage.
+
 Also rolls the churn harness's banked cells (``scenario-<name>-*.json``,
 written by scripts/scenarios.py) into the survivability matrix: scenario
 rows x (store, transport) columns, latest artifact per cell, OK / FAIL /
@@ -430,12 +439,20 @@ def print_tier(rows) -> None:
 
 
 def load_soak(artdir: pathlib.Path):
-    """One row per soak-*.json artifact (scripts/load_soak.py): rounds and
+    """One row per soak-family artifact (soak-* / replica-soak-* /
+    grow-soak-*, scripts/load_soak.py): rounds and
     exactness, sample count, mean/max total request rate, the worst
     windowed p99 over the hottest route, the RSS trajectory, and the
     sampler overhead A/B."""
     rows = []
-    for f in sorted(artdir.glob("soak-*.json")):
+    # the fault axes bank their own families (replica-soak-*, grow-soak-*)
+    # so bench_compare stays apples-to-apples, but the report shows them
+    # side by side — the artifact name carries the family
+    names = sorted(
+        f for pat in ("soak-*.json", "replica-soak-*.json", "grow-soak-*.json")
+        for f in artdir.glob(pat)
+    )
+    for f in names:
         try:
             d = json.loads(f.read_text())
         except (OSError, ValueError):
@@ -470,7 +487,7 @@ def load_soak(artdir: pathlib.Path):
 
 
 def print_soak(rows) -> None:
-    print("\nsustained-soak riders (soak-*.json):")
+    print("\nsustained-soak riders (soak-*/replica-soak-*/grow-soak-*.json):")
     print(
         f"{'dur_s':>6} {'rate':>6} {'rounds':>6} {'exact':>6} {'smpls':>5} "
         f"{'rps_mean':>8} {'rps_max':>8} {'worst_p99':>24} "
@@ -500,6 +517,76 @@ def print_soak(rows) -> None:
             f"{r['rps_mean'] if r['rps_mean'] is not None else '-':>8} "
             f"{r['rps_max'] if r['rps_max'] is not None else '-':>8} "
             f"{worst:>24} {rss:>13} {ov:>7}  {r['artifact']}{tag}"
+        )
+
+
+def load_flagship(artdir: pathlib.Path):
+    """One row per flagship-*.json campaign (scripts/flagship.py): the
+    composed-topology headline — certified max cohort, implied scale
+    factor against the simulated population, rung ladder shape, peak
+    certified phones/s, and the merged cross-process telemetry span."""
+    rows = []
+    for f in sorted(artdir.glob("flagship-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        if not isinstance(d, dict) or d.get("kind") != "flagship":
+            continue
+        topo = d.get("topology") if isinstance(d.get("topology"), dict) else {}
+        ladder = d.get("ladder") if isinstance(d.get("ladder"), list) else []
+        certified = [r for r in ladder
+                     if isinstance(r, dict) and r.get("certified")]
+        rates = [
+            r["cohort"] / r["round_s"] for r in certified
+            if isinstance(r.get("cohort"), (int, float))
+            and isinstance(r.get("round_s"), (int, float)) and r["round_s"] > 0
+        ]
+        merged = d.get("merged_samples") or []
+        procs = [s.get("procs", 0) for s in merged if isinstance(s, dict)]
+        rows.append(
+            {
+                "artifact": f.name,
+                "frontends": topo.get("frontend_processes"),
+                "shards": topo.get("shards"),
+                "replicas": topo.get("replicas"),
+                "tiers": topo.get("tiers"),
+                "certified_max": d.get("certified_max_cohort"),
+                "scale_factor": d.get("scale_factor"),
+                "rungs": (len(certified), len(ladder)),
+                "peak_per_s": max(rates) if rates else None,
+                "buckets": len(merged),
+                "peak_procs": max(procs) if procs else None,
+                "campaign_s": d.get("campaign_s"),
+            }
+        )
+    return rows
+
+
+def print_flagship(rows) -> None:
+    print("\nflagship campaigns (flagship-*.json):")
+    print(
+        f"{'topology':>12} {'cert_max':>8} {'scale_x':>8} {'rungs':>6} "
+        f"{'peak/s':>8} {'buckets':>7} {'procs':>5} {'wall_s':>7}  artifact"
+    )
+    for r in rows:
+        topo = (
+            f"{r['frontends']}fx{r['shards']}sx{r['replicas']}r"
+            if None not in (r["frontends"], r["shards"], r["replicas"])
+            else "-"
+        )
+        rungs = f"{r['rungs'][0]}/{r['rungs'][1]}"
+        peak = f"{r['peak_per_s']:.1f}" if r["peak_per_s"] is not None else "-"
+        print(
+            f"{topo:>12} "
+            f"{r['certified_max'] if r['certified_max'] is not None else '-':>8} "
+            f"{r['scale_factor'] if r['scale_factor'] is not None else '-':>8} "
+            f"{rungs:>6} "
+            f"{peak:>8} "
+            f"{r['buckets']:>7} "
+            f"{r['peak_procs'] if r['peak_procs'] is not None else '-':>5} "
+            f"{r['campaign_s'] if r['campaign_s'] is not None else '-':>7}  "
+            f"{r['artifact']}"
         )
 
 
@@ -598,6 +685,7 @@ def main() -> int:
     wire_rows = load_wire(artdir)
     tier_rows = load_tier(artdir)
     soak_rows = load_soak(artdir)
+    flagship_rows = load_flagship(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
         not rows
@@ -608,12 +696,14 @@ def main() -> int:
         and not wire_rows
         and not tier_rows
         and not soak_rows
+        and not flagship_rows
         and not scenario_cells
     ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
             f"reveal-*.json, committee-*.json, wire-*.json, tier-*.json, "
-            f"soak-*.json, or scenario-*.json artifacts under {artdir}/",
+            f"soak-*.json, flagship-*.json, or scenario-*.json artifacts "
+            f"under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -662,6 +752,8 @@ def main() -> int:
         print_tier(tier_rows)
     if soak_rows:
         print_soak(soak_rows)
+    if flagship_rows:
+        print_flagship(flagship_rows)
     if scenario_cells:
         print_scenarios(scenario_cells, overhead_rows)
     return 0

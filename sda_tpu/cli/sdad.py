@@ -56,7 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
         "committee", help="run several clerk identities concurrently"
     )
     committee.add_argument(
-        "-s", "--server", default="http://127.0.0.1:8888", help="SDA service URL"
+        "-s",
+        "--server",
+        action="append",
+        default=None,
+        metavar="URL",
+        help="SDA service URL; repeat once per frontend of a multi-frontend "
+        "deployment, in frontend order (every process must agree on it — "
+        "the clerks' keyed requests ring-route over the list exactly like "
+        "a multi-root client). Default http://127.0.0.1:8888",
     )
     committee.add_argument(
         "-i",
@@ -89,6 +97,7 @@ def run_committee_daemon(args) -> int:
     from ..protocol import Agent, SdaError
     from ..rest import SdaHttpClient, TokenStore
 
+    roots = args.server or ["http://127.0.0.1:8888"]
     clerks = []
     for d in args.identity:
         identity = Path(d)
@@ -99,10 +108,13 @@ def run_committee_daemon(args) -> int:
             SdaClient(
                 agent,
                 Keystore(identity / "keys"),
-                SdaHttpClient(args.server, TokenStore(identity)),
+                SdaHttpClient(roots, TokenStore(identity)),
             )
         )
-    log.info("running a committee of %d clerks against %s", len(clerks), args.server)
+    log.info(
+        "running a committee of %d clerks against %d frontend(s): %s",
+        len(clerks), len(roots), " ".join(roots),
+    )
     # bounded jittered backoff between polls: after a pass that found
     # work the queues are re-polled almost immediately (stragglers from
     # a snapshot land promptly); an idle or stalled server is probed at

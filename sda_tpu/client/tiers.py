@@ -32,6 +32,7 @@ the root reveals the exact sum of the survivors.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -44,12 +45,15 @@ from .receive import RecipientOutput
 class TierRoundNode:
     """One provisioned node: its topology position, the stored
     sub-aggregation record, the client that owns it (root recipient or
-    promoter), and its committee's clerk clients."""
+    promoter), its committee's clerk clients, and the frontend index the
+    pure placement function assigns its traffic (0 on single-frontend
+    deployments)."""
 
     node: tiers_mod.TierNode
     aggregation: object
     owner: object
     clerks: list
+    frontend: int = 0
 
 
 @dataclass
@@ -90,6 +94,7 @@ def setup_tier_round(
     clerk_pool: list,
     *,
     disjoint_committees: bool = False,
+    frontends: int = 1,
 ) -> TierRound:
     """Provision the whole derived tree of a tiered ``aggregation``:
     upload the root, derive + upload every sub-aggregation (parents
@@ -106,10 +111,17 @@ def setup_tier_round(
     clerk serves two nodes (the deployment shape the paper's per-clerk
     bound assumes; a wrapped pool still COMPUTES correctly, each clerk
     just works more than one node's share).
+
+    ``frontends`` is the frontend-process count of the deployment the
+    round runs against: each node is stamped with its deterministic
+    frontend index (``protocol.tiers.tier_placement``) so launchers can
+    place per-node committee daemons next to the frontend that will
+    serve their node's traffic.
     """
     if not aggregation.is_tiered():
         raise ValueError("setup_tier_round requires a tiered aggregation")
     topology = tiers_mod.iter_tier_nodes(aggregation)
+    placement = tiers_mod.tier_placement(aggregation, frontends)
     size = aggregation.committee_sharing_scheme.output_size
     if disjoint_committees:
         if len(clerk_pool) < size * len(topology):
@@ -143,7 +155,15 @@ def setup_tier_round(
             clerk_pool[(position * size + j) % len(clerk_pool)] for j in range(size)
         ]
         owner.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
-        nodes.append(TierRoundNode(node=node, aggregation=agg, owner=owner, clerks=clerks))
+        nodes.append(
+            TierRoundNode(
+                node=node,
+                aggregation=agg,
+                owner=owner,
+                clerks=clerks,
+                frontend=placement[agg.id],
+            )
+        )
     return TierRound(root=aggregation, recipient=recipient, nodes=nodes)
 
 
@@ -160,6 +180,37 @@ def promote_partial(promoter, values, parent_aggregation_id):
     return parts[0].id
 
 
+def _await_results(entries, poll_interval: float, deadline: float) -> None:
+    """External-clerks drain: the committees run as separate ``sdad
+    committee`` daemon processes over the wire, so instead of running
+    the clerk loop in-process this polls each node's aggregation status
+    until its snapshot reports ``result_ready`` (results count reached
+    the reconstruction threshold) — the exact condition the reveal
+    needs. Raises TimeoutError past ``deadline`` so a dead daemon fails
+    the round loudly instead of spinning forever."""
+    waiting = list(entries)
+    while waiting:
+        still = []
+        for tn in waiting:
+            status = tn.owner.service.get_aggregation_status(
+                tn.owner.agent, tn.aggregation.id
+            )
+            ready = status is not None and any(
+                s.result_ready for s in status.snapshots
+            )
+            if not ready:
+                still.append(tn)
+        waiting = still
+        if not waiting:
+            return
+        if time.monotonic() > deadline:
+            ids = [str(tn.aggregation.id) for tn in waiting]
+            raise TimeoutError(
+                f"external committees did not finish clerking: {ids}"
+            )
+        time.sleep(poll_interval)
+
+
 def _drain_clerks(entries, max_iterations: int) -> None:
     # one clerk client may serve several nodes' committees (wrapped
     # pool); drain each AGENT once per tier or the same durable queue
@@ -174,7 +225,13 @@ def _drain_clerks(entries, max_iterations: int) -> None:
 
 
 def run_tier_round(
-    round: TierRound, *, max_iterations: int = -1, strict: bool = True
+    round: TierRound,
+    *,
+    max_iterations: int = -1,
+    strict: bool = True,
+    external_clerks: bool = False,
+    poll_interval: float = 0.1,
+    poll_timeout: float = 120.0,
 ) -> TierRoundResult:
     """Run a provisioned tiered round bottom-up and reveal the root.
 
@@ -188,9 +245,24 @@ def run_tier_round(
     sub-cohort, unrevealable sub-committee): they are recorded in
     ``TierRoundResult.skipped`` and the root reveals the exact sum of
     the survivors. Under ``strict=True`` any sub-tier failure raises.
+
+    ``external_clerks=True`` is the process-spanning mode: committees
+    run as separate ``sdad committee`` daemons over the wire, so the
+    driver never runs a clerk loop in-process — it just waits (up to
+    ``poll_timeout`` seconds per tier) for each closed node's snapshot
+    to report ``result_ready`` before revealing.
     """
     depth = tiers_mod.tier_depth(round.root)
     skipped = []
+
+    def _drain(entries):
+        if external_clerks:
+            _await_results(
+                entries, poll_interval, time.monotonic() + poll_timeout
+            )
+        else:
+            _drain_clerks(entries, max_iterations)
+
     for tier in range(depth - 1, 0, -1):
         entries = [tn for tn in round.nodes if tn.node.tier == tier]
         live = []
@@ -203,7 +275,7 @@ def run_tier_round(
                 skipped.append(tn.aggregation.id)
                 continue
             live.append(tn)
-        _drain_clerks(live, max_iterations)
+        _drain(live)
         for tn in live:
             try:
                 partial = tn.owner.reveal_aggregation(tn.aggregation.id).positive()
@@ -214,6 +286,6 @@ def run_tier_round(
                 continue
             promote_partial(tn.owner, partial.values, tn.node.parent)
     round.recipient.end_aggregation(round.root.id)
-    _drain_clerks([round.nodes[0]], max_iterations)
+    _drain([round.nodes[0]])
     output = round.recipient.reveal_aggregation(round.root.id)
     return TierRoundResult(output=output, skipped=skipped)

@@ -27,6 +27,7 @@ import uuid
 from dataclasses import dataclass
 from typing import Optional
 
+from ..utils.hashring import HashRing
 from .ids import AggregationId
 from .resources import Aggregation
 from .schemes import SodiumEncryptionScheme
@@ -77,6 +78,35 @@ def leaf_aggregation_id(root: Aggregation, participant_id) -> AggregationId:
         node = child_aggregation_id(node, ix)
         depth -= 1
     return node
+
+
+def frontend_for(aggregation_id, frontends: int) -> int:
+    """Which of ``frontends`` REST frontends serves ``aggregation_id``'s
+    traffic. This is exactly the multi-root client's routing function
+    (``HashRing(len(roots)).shard_for(str(key))`` — see
+    ``rest/client.py``), exposed as a pure topology function so tier
+    drivers can pin each node's committee daemon next to the frontend
+    its requests will land on WITHOUT asking any coordinator: every
+    party derives the same placement from the root id alone."""
+    if frontends < 1:
+        raise ValueError("placement needs at least one frontend")
+    return HashRing(frontends).shard_for(str(aggregation_id))
+
+
+def tier_placement(root: Aggregation, frontends: int) -> dict:
+    """Deterministic tier→frontend placement for the whole derived tree:
+    ``{aggregation_id: frontend_index}`` for every node of ``root``'s
+    topology. A pure function of (root id, frontend count) — clients,
+    committee daemons, and launchers all compute the identical map, so a
+    sub-committee process can be spawned pointing at exactly the
+    frontend that will serve its node's wire traffic."""
+    ring = HashRing(frontends) if frontends > 1 else None
+    return {
+        node.aggregation_id: (
+            ring.shard_for(str(node.aggregation_id)) if ring is not None else 0
+        )
+        for node in iter_tier_nodes(root)
+    }
 
 
 @dataclass(frozen=True)

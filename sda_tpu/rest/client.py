@@ -171,6 +171,17 @@ class SdaHttpClient(SdaService):
         q = _quarantine_s()
         return now + (self._jitter.uniform(0.0, q) if q > 0 else 0.0)
 
+    def route_index(self, route_key) -> int:
+        """Which frontend (index into ``self.roots``) ``route_key``'s
+        traffic homes on. The client-side face of the pure placement
+        function (``protocol.tiers.frontend_for``): both compute
+        ``HashRing(len(roots)).shard_for(str(key))``, so a launcher can
+        place a node's committee daemon on the exact frontend the
+        client's keyed requests will use (failover aside)."""
+        if self._ring is None:
+            return 0
+        return self._ring.shard_for(str(route_key))
+
     def _candidate_roots(self, route_key) -> list:
         """Frontend base URLs in try-order for this request: the key's
         ring-preference order (or plain frontend order when unkeyed),

@@ -727,9 +727,19 @@ class _RequestContext:
             return True
 
         if method == "POST" and (match := m(rf"/v1/aggregations/implied/jobs/({_UUID})/result")):
-            svc.create_clerking_result(
-                self._caller(), self._read(ClerkingResult.from_json)
-            )
+            result = self._read(ClerkingResult.from_json)
+            # the route is job-scoped: a body naming a DIFFERENT job
+            # would silently file the result under the body's job while
+            # every URL-derived check looked at the route's — reject the
+            # mismatch instead of trusting whichever id the caller likes
+            # (the reference marks the equivalent hole "FIXME no job
+            # spoofing", server.rs:351; closed here)
+            if str(result.job) != match.group(1):
+                raise InvalidRequestError(
+                    f"result body names job {result.job}, "
+                    f"route names {match.group(1)}"
+                )
+            svc.create_clerking_result(self._caller(), result)
             self._send(201)
             return True
 

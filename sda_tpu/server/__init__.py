@@ -156,6 +156,14 @@ def new_sharded_server(
             clerking_job_store=ShardedClerkingJobsStore(jobs, router),
         )
     )
+    # elastic scale-out seam: router.add_shard() builds partition K
+    # through the same factory (and telemetry proxy) the initial layout
+    # used, so a grown shard is indistinguishable from a seeded one
+    def _grow_partition(ix: int):
+        p = _partition(ix)
+        return instrument_store(p[2], kind), instrument_store(p[3], kind)
+
+    router.new_partition = _grow_partition
     service.shard_router = router
     if router.replicas > 1:
         router.start_repair()

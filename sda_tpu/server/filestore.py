@@ -229,6 +229,14 @@ class FileAggregationsStore(AggregationsStore):
     def count_participations(self, aggregation_id) -> int:
         return len(self._participations(aggregation_id).list_ids())
 
+    def iter_participations(self, aggregation_id):
+        table = self._participations(aggregation_id)
+        for pid in sorted(table.list_ids(), key=str):
+            payload = table.get(pid)
+            if payload is None:
+                continue  # raced a concurrent delete — nothing to copy
+            yield Participation.from_json(payload)
+
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         # write-once: a retry after a partial snapshot must not re-freeze a
         # different membership (participations may have arrived in between)

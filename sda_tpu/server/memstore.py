@@ -193,6 +193,11 @@ class MemAggregationsStore(AggregationsStore):
         with self._lock:
             return len(self._participations.get(aggregation_id, {}))
 
+    def iter_participations(self, aggregation_id):
+        with self._lock:
+            table = self._participations.get(aggregation_id, {})
+            return iter(sorted(table.values(), key=lambda p: str(p.id)))
+
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         with self._lock:
             # write-once: retries must not re-freeze a different membership

@@ -76,6 +76,7 @@ replica plane adds ``sda_shard_replica_writes_total{shard,outcome}``
 from __future__ import annotations
 
 import collections
+import logging
 import os
 import threading
 from typing import Iterable, Iterator, Optional
@@ -84,6 +85,8 @@ from .. import telemetry
 from ..protocol import SdaError, ServerError
 from ..utils.hashring import HashRing
 from . import stores
+
+log = logging.getLogger("sda.shard")
 
 
 class ShardDownError(Exception):
@@ -136,6 +139,24 @@ class ShardRouter:
         self._stores: dict = {}  # "agg"/"jobs" -> partition list (attach())
         self._repair_stop: Optional[threading.Event] = None
         self._repair_thread: Optional[threading.Thread] = None
+        # -- elastic scale-out (add_shard / finish_add_shard) -------------
+        #: shards mid-migration: writes hint to them, reads skip them
+        self._warming: set = set()
+        #: warming shards whose bulk copy has not landed yet — the
+        #: handoff drain must hold off (hints replay AFTER the base copy)
+        self._copying: set = set()
+        #: the grown ring while a migration is in flight (targets() adds
+        #: its preference prefix to the current ring's, old shards first)
+        self._next_ring: Optional[HashRing] = None
+        #: factory building partition ``ix`` on demand, attached by
+        #: ``new_sharded_server`` — ``None`` means this deployment
+        #: cannot grow (hand-assembled partition lists)
+        self.new_partition = None
+        # write gate: finish_add_shard's flip drains in-flight writes,
+        # swaps the ring, and releases — the only moment writes pause
+        self._gate = threading.Condition()
+        self._inflight = 0
+        self._paused = False
 
     # -- telemetry ---------------------------------------------------------
 
@@ -181,10 +202,26 @@ class ShardRouter:
 
     def targets(self, key) -> tuple:
         """The write/read set for ``key``: the first R shards of the
-        ring's preference walk (just the home shard when R == 1)."""
-        if self.replicas == 1:
+        ring's preference walk (just the home shard when R == 1).
+
+        While a shard add is migrating, keys the grown ring moves get
+        the UNION of both rings' prefixes, old shards first: reads stay
+        authoritative on the current home (the new shard is skipped as
+        warming anyway), while every write is also queued for the future
+        home as a hinted handoff — so by flip time the new shard holds
+        base copy + replayed deltas and nothing is lost."""
+        next_ring = self._next_ring
+        if next_ring is None and self.replicas == 1:
             return (self.aggregation_shard(key),)
-        return tuple(self.ring.preference(str(key))[: self.replicas])
+        out = tuple(self.ring.preference(str(key))[: self.replicas])
+        if next_ring is not None:
+            grown = [
+                ix
+                for ix in next_ring.preference(str(key))[: self.replicas]
+                if ix not in out
+            ]
+            out = out + tuple(grown)
+        return out
 
     def note_snapshot(self, snapshot_id, ixs) -> None:
         self._snapshot_targets[str(snapshot_id)] = (
@@ -226,9 +263,19 @@ class ShardRouter:
             return os.path.exists(self.down_marker(self.root, ix))
         return False
 
+    def shard_warming(self, ix: int) -> bool:
+        """True while ``ix`` is a mid-migration shard: its contents are
+        a partial copy, so reads must not treat it as authoritative."""
+        return ix in self._warming
+
     def check_up(self, ix: int) -> None:
         if self.shard_down(ix):
             raise ShardDownError(f"shard {ix} is down")
+        if ix in self._warming:
+            # writes treat a warming shard exactly like a down one:
+            # they queue as hints, which replay (in order, after the
+            # bulk copy) instead of racing the copier
+            raise ShardDownError(f"shard {ix} is warming")
 
     # -- hinted handoff ----------------------------------------------------
 
@@ -261,16 +308,20 @@ class ShardRouter:
         blocked: set = set()  # shards that must keep FIFO order this pass
         for hint in pending:
             kind, ix, op, args, attempts = hint
-            if ix in blocked or self.shard_down(ix):
+            if ix in blocked or ix in self._copying or self.shard_down(ix):
                 blocked.add(ix)
                 requeue.append(hint)
                 continue
             try:
                 getattr(self._stores[kind][ix], op)(*args)
-            except Exception:
+            except Exception as exc:
                 hint[4] = attempts + 1
                 if hint[4] >= max_attempts:
                     self.tick_replica(ix, "abandoned")
+                    log.error(
+                        "handoff hint %s to shard %d abandoned after %d "
+                        "attempts: %r", op, ix, hint[4], exc
+                    )
                 else:
                     blocked.add(ix)
                     requeue.append(hint)
@@ -315,6 +366,187 @@ class ShardRouter:
         self._repair_stop = None
         self._repair_thread = None
 
+    # -- write gate (used by the grow flip) --------------------------------
+
+    def write_begin(self) -> None:
+        with self._gate:
+            while self._paused:
+                self._gate.wait()
+            self._inflight += 1
+
+    def write_end(self) -> None:
+        with self._gate:
+            self._inflight -= 1
+            self._gate.notify_all()
+
+    # -- elastic scale-out -------------------------------------------------
+
+    def add_shard(self) -> int:
+        """Begin a live scale-out to K+1 shards. Builds partition K via
+        the attached factory, registers it with both sharded stores
+        (``attach`` shares the list objects, so the append is visible
+        everywhere), marks it warming+copying, and installs the grown
+        ring as ``_next_ring`` — from this moment every write to a key
+        the grown ring moves is ALSO queued for the new shard as a
+        hinted handoff. Returns the new shard's index. The shard serves
+        nothing until ``finish_add_shard`` flips the ring."""
+        if self.new_partition is None:
+            raise ServerError(
+                "this deployment has no partition factory; cannot grow"
+            )
+        if self._next_ring is not None:
+            raise ServerError("a shard add is already in progress")
+        ix = self.shards
+        agg_part, jobs_part = self.new_partition(ix)
+        # warming/copying BEFORE the partitions become reachable: no
+        # reader may ever treat the empty partition as authoritative
+        self._warming.add(ix)
+        self._copying.add(ix)
+        self._stores["agg"].append(agg_part)
+        self._stores["jobs"].append(jobs_part)
+        self._next_ring = HashRing(self.shards + 1)
+        return ix
+
+    def moved_aggregations(self) -> list:
+        """Every (aggregation id, old targets, new targets) whose target
+        set the in-flight grow changes — the bulk-copy work list,
+        enumerated from the old partitions' own tables (no separate
+        catalog exists or is needed)."""
+        if self._next_ring is None:
+            return []
+        seen: set = set()
+        moved = []
+        for src_ix in range(self.shards):
+            part = self._stores["agg"][src_ix]
+            if self.shard_down(src_ix):
+                continue
+            try:
+                ids = part.list_aggregations(None, None)
+            except Exception:
+                continue  # a down replica's rows live on its peers
+            for agg_id in ids:
+                key = str(agg_id)
+                if key in seen:
+                    continue
+                seen.add(key)
+                old = tuple(self.ring.preference(key)[: self.replicas])
+                new = tuple(self._next_ring.preference(key)[: self.replicas])
+                if old != new:
+                    moved.append((agg_id, old, new))
+        return moved
+
+    def _copy_aggregation(self, agg_id, src_ixs, dst_ix) -> None:
+        """Copy one aggregation's full state from its current replica
+        set onto the warming shard, in dependency order. Every store
+        write is create-if-identical, so re-copies and later hint
+        replays of the same rows are absorbed.
+
+        Frozen snapshot membership is reproduced by construction: only
+        the SNAPPED participations are copied before the membership
+        freeze is replayed, so the destination freezes exactly the
+        source's member set (the mask list is copied verbatim — nothing
+        pairs masks and members positionally, reveals sum both)."""
+        parts = self._stores["agg"]
+        dst = parts[dst_ix]
+        src = None
+        for ix in src_ixs:
+            if not self.shard_down(ix):
+                src = parts[ix]
+                break
+        if src is None:
+            raise ShardDownError(f"no live replica to copy {agg_id} from")
+        agg = src.get_aggregation(agg_id)
+        if agg is None:
+            return  # deleted while the work list was being walked
+        dst.create_aggregation(agg)
+        committee = src.get_committee(agg_id)
+        if committee is not None:
+            dst.create_committee(committee)
+        for snap_id in src.list_snapshots(agg_id):
+            snapshot = src.get_snapshot(agg_id, snap_id)
+            if snapshot is None:
+                continue
+            for p in src.iter_snapped_participations(agg_id, snap_id):
+                dst.create_participation(p)
+            dst.create_snapshot(snapshot)
+            dst.snapshot_participations(agg_id, snap_id)
+            self.note_snapshot(snap_id, self.targets(agg_id))
+            mask = src.get_snapshot_mask(snap_id)
+            if mask is not None:
+                dst.create_snapshot_mask(snap_id, mask)
+        for p in src.iter_participations(agg_id):
+            dst.create_participation(p)
+
+    def migrate_once(self) -> int:
+        """One bulk-copy pass of the in-flight grow: copy every moved
+        aggregation onto the warming shard, then open the shard to the
+        handoff drain (hints replay the writes that raced the copy).
+        Returns the number of aggregations copied. Idempotent."""
+        if self._next_ring is None:
+            return 0
+        new_ix = self.shards  # the warming shard
+        copied = 0
+        for agg_id, old, new in self.moved_aggregations():
+            if new_ix not in new:
+                continue  # moved between old shards cannot happen; guard anyway
+            self._copy_aggregation(agg_id, old, new_ix)
+            copied += 1
+        # base copy landed: let the repair thread replay queued deltas
+        self._copying.discard(new_ix)
+        return copied
+
+    def finish_add_shard(self, timeout: float = 30.0) -> None:
+        """Complete the grow: wait for the handoff queue to drain onto
+        the (now copied) warming shard, briefly pause writes, drain the
+        residual hints, atomically flip to the grown ring, and resume.
+        After the flip the new shard is a full member: reads for moved
+        keys land on it first and the old copies are plain garbage that
+        replicated merges dedupe away."""
+        import time as _time
+
+        if self._next_ring is None:
+            raise ServerError("no shard add in progress")
+        new_ix = self.shards
+        if new_ix in self._copying:
+            self.migrate_once()
+        deadline = _time.monotonic() + timeout
+        while self.hint_depth() and _time.monotonic() < deadline:
+            self.drain_hints_once()
+            if self.hint_depth():
+                _time.sleep(0.02)
+        # flip under the write gate: no write may straddle the ring swap
+        with self._gate:
+            self._paused = True
+            while self._inflight:
+                if not self._gate.wait(timeout=timeout):
+                    break
+            try:
+                # residual hints enqueued by the last in-flight writes
+                while self.hint_depth():
+                    if self.drain_hints_once() == 0:
+                        break
+                if self.hint_depth():
+                    raise ServerError(
+                        "grow flip aborted: handoff queue did not drain "
+                        f"({self.hint_depth()} hints pending)"
+                    )
+                self.ring = self._next_ring
+                self.shards += 1
+                self._next_ring = None
+                self._warming.discard(new_ix)
+                self._copying.discard(new_ix)
+            finally:
+                self._paused = False
+                self._gate.notify_all()
+
+    def grow(self, timeout: float = 30.0) -> int:
+        """Convenience one-call scale-out: add a shard, bulk-copy the
+        moved keys, drain, flip. Returns the new shard index."""
+        ix = self.add_shard()
+        self.migrate_once()
+        self.finish_add_shard(timeout=timeout)
+        return ix
+
 
 class _ReplicatedPartitions:
     """Shared read/write machinery over a partition list. ``_kind``
@@ -334,38 +566,50 @@ class _ReplicatedPartitions:
 
         Quorum ``ceil((R+1)/2)`` where a down replica's queued hint
         counts as a (durable-intent) ack; at least one replica must
-        really accept. Logical rejections propagate untouched."""
+        really accept. Logical rejections propagate untouched.
+
+        ``targets`` may exceed R while a shard add is migrating (the
+        union set); the extra warming shard is not a quorum participant
+        — its write always queues as a hint — so the quorum math stays
+        a function of R alone."""
         router = self._router
-        if router.replicas == 1:
-            ix = targets[0]
-            router.touch(ix)
-            getattr(self._parts[ix], op)(*args)
-            return
-        quorum = (router.replicas + 2) // 2
-        acks = 0
-        hinted = []
-        first_err = None
-        for ix in targets:
-            router.touch(ix)
-            try:
-                router.check_up(ix)
+        router.write_begin()
+        try:
+            if len(targets) == 1:
+                ix = targets[0]
+                router.touch(ix)
                 getattr(self._parts[ix], op)(*args)
-            except SdaError:
-                raise  # deterministic logical rejection, same everywhere
-            except Exception as exc:
-                router.tick_replica(ix, "hinted")
-                hinted.append(ix)
-                if first_err is None:
-                    first_err = exc
-                continue
-            router.tick_replica(ix, "ok")
-            acks += 1
-        if acks == 0 or acks + len(hinted) < quorum:
-            raise first_err if first_err is not None else ServerError(
-                f"write quorum failed: {op}"
-            )
-        for ix in hinted:
-            router.add_hint(self._kind, ix, op, args)
+                return
+            quorum = (router.replicas + 2) // 2
+            acks = 0
+            hinted = []
+            first_err = None
+            for ix in targets:
+                router.touch(ix)
+                try:
+                    router.check_up(ix)
+                    getattr(self._parts[ix], op)(*args)
+                except SdaError:
+                    raise  # deterministic logical rejection, same everywhere
+                except Exception as exc:
+                    router.tick_replica(ix, "hinted")
+                    log.warning(
+                        "replica write %s to shard %d hinted: %r", op, ix, exc
+                    )
+                    hinted.append(ix)
+                    if first_err is None:
+                        first_err = exc
+                    continue
+                router.tick_replica(ix, "ok")
+                acks += 1
+            if acks == 0 or acks + len(hinted) < quorum:
+                raise first_err if first_err is not None else ServerError(
+                    f"write quorum failed: {op}"
+                )
+            for ix in hinted:
+                router.add_hint(self._kind, ix, op, args)
+        finally:
+            router.write_end()
 
     # -- reads -------------------------------------------------------------
 
@@ -374,7 +618,7 @@ class _ReplicatedPartitions:
         record answers; earlier live-but-missing replicas get the record
         written back when ``repair(part, out)`` is provided."""
         router = self._router
-        if router.replicas == 1:
+        if len(targets) == 1:
             ix = targets[0]
             router.touch(ix)
             return getattr(self._parts[ix], op)(*args)
@@ -414,7 +658,7 @@ class _ReplicatedPartitions:
         there is no miss-walk — replicas converge once the handoff
         queue drains)."""
         router = self._router
-        if router.replicas == 1:
+        if len(targets) == 1:
             ix = targets[0]
             router.touch(ix)
             return getattr(self._parts[ix], op)(*args)
@@ -441,8 +685,13 @@ class _ReplicatedPartitions:
 
     def _live_parts(self):
         """Fan-out iteration; when R > 1 a down partition is skipped
-        (its rows live on R-1 other replicas)."""
+        (its rows live on R-1 other replicas). A warming partition —
+        the target of an in-flight shard add — is always skipped: its
+        contents are a partial copy of state that still lives, in
+        full, on the old shards."""
         for ix, part in enumerate(self._parts):
+            if self._router.shard_warming(ix):
+                continue
             if self._router.replicas > 1 and self._router.shard_down(ix):
                 continue
             yield ix, part
@@ -475,16 +724,11 @@ class ShardedAggregationsStore(_ReplicatedPartitions, stores.AggregationsStore):
     # -- aggregations --------------------------------------------------------
 
     def list_aggregations(self, filter: Optional[str], recipient) -> list:
+        # first-seen dedupe in every mode: with R > 1 each aggregation
+        # appears on R shards, and after a grow a moved key's absorbed
+        # copy lingers on its former home until garbage-collected
         router = self._router
-        if router.replicas == 1:
-            out: list = []
-            for ix, part in enumerate(self._parts):
-                router.touch(ix)
-                out.extend(part.list_aggregations(filter, recipient))
-            return out
-        # replicated: each aggregation appears on R shards — merge with
-        # a first-seen dedupe, skipping down partitions
-        out = []
+        out: list = []
         seen: set = set()
         for ix, part in self._live_parts():
             router.touch(ix)
@@ -493,6 +737,8 @@ class ShardedAggregationsStore(_ReplicatedPartitions, stores.AggregationsStore):
             except SdaError:
                 raise
             except Exception:
+                if router.replicas == 1:
+                    raise  # single-copy plane: a dead partition is fatal
                 continue
             for row in rows:
                 key = str(row)
@@ -563,6 +809,13 @@ class ShardedAggregationsStore(_ReplicatedPartitions, stores.AggregationsStore):
     def count_participations(self, aggregation_id) -> int:
         return self._read_any(
             "count_participations",
+            (aggregation_id,),
+            self._router.targets(aggregation_id),
+        )
+
+    def iter_participations(self, aggregation_id):
+        return self._read_any(
+            "iter_participations",
             (aggregation_id,),
             self._router.targets(aggregation_id),
         )
@@ -705,9 +958,13 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
 
     def enqueue_clerking_job_chunked(self, job, chunks: Iterable) -> None:
         targets = self._enqueue_targets(job)
-        if self._router.replicas > 1:
+        if len(targets) > 1:
             # the chunk stream is single-use: materialize so the write
-            # can replay across replicas (and later from a hint). The
+            # can replay across replicas (and later from a hint) — the
+            # union write set of an in-flight shard grow needs this even
+            # at R=1, or the hint would replay an exhausted iterator
+            # (and, via the default chunked enqueue's job mutation,
+            # blank the column the first shard already stored). The
             # replication trade: peak memory goes from one chunk to one
             # job column while the write is in flight.
             chunks = list(chunks)
@@ -725,7 +982,16 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
                     raise
                 continue
             if job is not None:
-                self._router.note_job(job.id, self._router.targets(job.aggregation))
+                # never clobber the entry recorded at enqueue time: a
+                # job enqueued before a shard grow lives with its
+                # aggregation's FORMER replica set, and the current
+                # ring's derivation would point result writes at shards
+                # that never saw the job
+                if self._router.job_targets(job.id) is None:
+                    targets = self._router.targets(job.aggregation)
+                    self._router.note_job(
+                        job.id, targets if ix in targets else (ix,)
+                    )
                 return job
         return None
 
@@ -744,7 +1010,19 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
                     raise
                 continue
             if out is not None:
-                self._router.note_job(job_id, ix)
+                # cache routing only when the record lets us derive the
+                # FULL replica set (a job carries its aggregation). A
+                # bare probe index must never land in the map: writes
+                # trust it, so caching one replica here would silently
+                # degrade the later result write to a single-replica
+                # write — no quorum, no hint, and a round that hangs on
+                # whichever replica the status read happens to consult.
+                agg = getattr(out, "aggregation", None)
+                if agg is not None:
+                    targets = self._router.targets(agg)
+                    self._router.note_job(
+                        job_id, targets if ix in targets else (ix,)
+                    )
                 return out
         return None
 
@@ -777,6 +1055,11 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
                     continue
                 if job is not None:
                     targets = self._router.targets(job.aggregation)
+                    if probe not in targets:
+                        # the job predates a shard grow: it lives with
+                        # its aggregation's former replica set, so write
+                        # where the job actually is
+                        targets = (probe,)
                     self._router.note_job(result.job, targets)
                     break
         if targets is None:
@@ -793,7 +1076,15 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
         targets = self._router.snapshot_targets(snapshot_id)
         if targets is None:
             return None, False
-        return self._read_any(op, (snapshot_id,) + args, targets), True
+        out = self._read_any(op, (snapshot_id,) + args, targets)
+        if not out:
+            # an EMPTY routed answer is not authoritative here: after a
+            # shard grow the map re-warms to the aggregation's new home
+            # while job rows enqueued before the grow stay behind on the
+            # former home — re-answer with the fan-out merge (exact: a
+            # snapshot's jobs all live somewhere, and the merge dedupes)
+            return None, False
+        return out, True
 
     def list_results(self, snapshot_id) -> list:
         out, routed = self._snap_read(snapshot_id, "list_results")
@@ -821,7 +1112,11 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
     def get_result(self, snapshot_id, job_id):
         targets = self._router.snapshot_targets(snapshot_id)
         if targets is not None:
-            return self._read_record("get_result", (snapshot_id, job_id), targets)
+            out = self._read_record("get_result", (snapshot_id, job_id), targets)
+            if out is not None:
+                return out
+            # routed miss: the result may live with the job's pre-grow
+            # home rather than the snapshot's current one
         return self._job_read(job_id, "get_result", snapshot_id, job_id)
 
     def get_results(self, snapshot_id) -> list:
@@ -851,12 +1146,9 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
         out, routed = self._snap_read(snapshot_id, "count_results")
         if routed:
             return out
-        if self._router.replicas == 1:
-            total = 0
-            for ix, part in enumerate(self._parts):
-                self._router.touch(ix)
-                total += part.count_results(snapshot_id)
-            return total
+        # merged count in every mode: a plain per-partition sum would
+        # double-count rows that exist on both a moved key's former and
+        # current home after a shard grow
         return len(self.list_results(snapshot_id))
 
     def get_results_range(self, snapshot_id, start: int, count: int) -> list:

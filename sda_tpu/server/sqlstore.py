@@ -494,6 +494,24 @@ class SqliteAggregationsStore(AggregationsStore):
         )
         return row[0]
 
+    def iter_participations(self, aggregation_id):
+        # ordered full scan for the shard-migration copier: id-keyed
+        # batches keep memory bounded like iter_snapped_participations
+        a = str(aggregation_id)
+        last = ""
+        batch = 1024
+        while True:
+            rows = self.db.query_all(
+                "SELECT id, body FROM participations "
+                "WHERE aggregation = ? AND id > ? ORDER BY id LIMIT ?",
+                (a, last, batch),
+            )
+            if not rows:
+                return
+            for pid, body in rows:
+                yield Participation.from_json(json.loads(body))
+            last = rows[-1][0]
+
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         s = str(snapshot_id)
         with self.db.transaction() as conn:

@@ -161,6 +161,14 @@ class AggregationsStore(BaseStore):
     @abc.abstractmethod
     def create_participation(self, participation) -> None: ...
 
+    @abc.abstractmethod
+    def iter_participations(self, aggregation_id):
+        """Every stored participation of ``aggregation_id``, in a stable
+        (id-sorted) order. Snapshot-independent — this is the raw table
+        scan the shard-migration copier replays onto a new partition,
+        not the frozen-membership iteration the transpose uses."""
+        ...
+
     def create_participations(self, participations) -> None:
         """Bulk write of pre-validated participations — the storage half of
         the batched ingest pipeline.

@@ -415,6 +415,85 @@ def get() -> TimeSeriesSampler | None:
     return _global_sampler
 
 
+def merge_histories(histories, bucket_s: float | None = None) -> list:
+    """Merge per-process ``/v1/metrics/history`` bodies into one fleet
+    series.
+
+    A multi-process deployment (N ``sdad httpd`` frontends plus committee
+    daemons) has N independent samplers, each banking its own windows on
+    its own clock. This aligns them on wall-clock buckets of ``bucket_s``
+    seconds (default: the largest ``interval_s`` reported, else 5s) and
+    folds every bucket's samples into one:
+
+    - additive columns are **summed** across processes: route ``rps``,
+      ``rates``, ``wire_bytes_per_s``, per-shard request rates, store-op
+      ``ops_s``, and ``rss_mib`` (total fleet RSS);
+    - latency quantiles are **maxed** — per-process quantiles cannot be
+      re-aggregated without the underlying buckets, and the conservative
+      fleet p99 is the slowest process's p99;
+    - ``procs`` counts the processes contributing to the bucket, so a
+      gap (dead frontend, late scrape) is visible instead of silently
+      deflating the fleet rate.
+
+    Accepts either full history bodies (``{"samples": [...]}``) or bare
+    sample lists. Returns merged samples sorted by bucket time.
+    """
+    sample_lists = []
+    intervals = []
+    for h in histories:
+        if isinstance(h, dict):
+            sample_lists.append(h.get("samples") or [])
+            if h.get("interval_s"):
+                intervals.append(float(h["interval_s"]))
+        else:
+            sample_lists.append(list(h or []))
+    if bucket_s is None:
+        bucket_s = max(intervals) if intervals else 5.0
+    bucket_s = max(1e-3, float(bucket_s))
+
+    _QUANTS = ("p50_s", "p95_s", "p99_s")
+    buckets: dict = {}
+    for samples in sample_lists:
+        for s in samples:
+            key = int(s["t"] // bucket_s)
+            m = buckets.setdefault(
+                key,
+                {
+                    "t": (key + 1) * bucket_s,
+                    "dt_s": bucket_s,
+                    "procs": 0,
+                    "rss_mib": 0.0,
+                    "routes": {},
+                    "store_ops": {},
+                    "wire_bytes_per_s": {},
+                    "rates": {},
+                },
+            )
+            m["procs"] += 1
+            m["rss_mib"] = round(m["rss_mib"] + s.get("rss_mib", 0.0), 2)
+            for route, entry in (s.get("routes") or {}).items():
+                out = m["routes"].setdefault(route, {"rps": 0.0})
+                out["rps"] = round(out["rps"] + entry.get("rps", 0.0), 3)
+                for q in _QUANTS:
+                    if q in entry:
+                        out[q] = max(out.get(q, 0.0), entry[q])
+            for op, entry in (s.get("store_ops") or {}).items():
+                out = m["store_ops"].setdefault(op, {"ops_s": 0.0})
+                out["ops_s"] = round(out["ops_s"] + entry.get("ops_s", 0.0), 3)
+                if "p99_s" in entry:
+                    out["p99_s"] = max(out.get("p99_s", 0.0), entry["p99_s"])
+            for k, v in (s.get("wire_bytes_per_s") or {}).items():
+                m["wire_bytes_per_s"][k] = round(
+                    m["wire_bytes_per_s"].get(k, 0.0) + v, 1
+                )
+            for k, v in (s.get("rates") or {}).items():
+                m["rates"][k] = round(m["rates"].get(k, 0.0) + v, 3)
+            for k, v in (s.get("shards") or {}).items():
+                m.setdefault("shards", {})
+                m["shards"][k] = round(m["shards"].get(k, 0.0) + v, 3)
+    return [buckets[k] for k in sorted(buckets)]
+
+
 def history(n: int | None = None) -> dict:
     """The ``/v1/metrics/history`` response body: sampler state + the
     newest ``n`` samples (all retained samples when ``n`` is omitted)."""

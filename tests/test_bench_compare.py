@@ -101,3 +101,72 @@ def test_single_artifact_and_garbage_are_na(tmp_path, capsys):
 
 def test_empty_dir_is_not_a_regression(tmp_path):
     assert _run(tmp_path) == 0
+
+
+def test_gate_narrows_failures_to_listed_families(tmp_path, capsys):
+    """--gate demotes regressions in unlisted families to advisory: the
+    run reports them but exits 0, so ci.sh can hard-gate the distributed
+    planes while the single-process riders stay informational."""
+    _write(tmp_path, "wire-20260801-010000.json",
+           {"binary": {"ingest_per_s": 50000}})
+    _write(tmp_path, "wire-20260805-010000.json",
+           {"binary": {"ingest_per_s": 10000}})  # -80%, but ungated
+    _write(tmp_path, "shard-20260801-010000.json",
+           {"legs": {"k2": {"ingest_per_s": 900}}})
+    _write(tmp_path, "shard-20260805-010000.json",
+           {"legs": {"k2": {"ingest_per_s": 880}}})  # -2.2%: noise
+    assert _run(tmp_path, "--gate", "shard,tier,replication") == 0
+    out = capsys.readouterr().out
+    assert "regressed (advisory)" in out
+    # the same drop fails once wire is gated (default gates everything)
+    assert _run(tmp_path) == 1
+
+
+def test_gated_family_regression_still_fails(tmp_path):
+    _write(tmp_path, "shard-20260801-010000.json",
+           {"legs": {"k2": {"ingest_per_s": 900}}})
+    _write(tmp_path, "shard-20260805-010000.json",
+           {"legs": {"k2": {"ingest_per_s": 400}}})  # -55%
+    assert _run(tmp_path, "--gate", "shard,tier,replication") == 1
+
+
+def test_unknown_gate_family_is_an_error(tmp_path):
+    try:
+        _run(tmp_path, "--gate", "no-such-family")
+    except SystemExit as exc:
+        assert exc.code == 2  # argparse usage error
+    else:
+        raise AssertionError("unknown --gate family was accepted")
+
+
+def test_flagship_certified_cohort_drop_fails(tmp_path, capsys):
+    """A ladder that stops certifying earlier is a headline regression:
+    512 -> 256 certified cohort is -50%, far past any threshold."""
+    ladder_hi = [{"rung": i, "cohort": 8 << i, "round_s": 2.0 + i,
+                  "certified": True} for i in range(7)]
+    ladder_lo = ladder_hi[:6]
+    _write(tmp_path, "flagship-20260801-010000.json",
+           {"kind": "flagship", "certified_max_cohort": 512,
+            "ladder": ladder_hi})
+    _write(tmp_path, "flagship-20260805-010000.json",
+           {"kind": "flagship", "certified_max_cohort": 256,
+            "ladder": ladder_lo})
+    assert _run(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "certified_max_cohort" in out and "peak_cohort_per_s" in out
+
+
+def test_grow_soak_family_is_separate_from_soak(tmp_path, capsys):
+    """grow-soak-* must compare against other grow-soak runs, never
+    against plain soak-* (a grow pass is slower by construction)."""
+    _write(tmp_path, "soak-20260801-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 100.0}})
+    _write(tmp_path, "grow-soak-20260801-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 40.0}})
+    _write(tmp_path, "grow-soak-20260805-010000.json",
+           {"kind": "soak", "summary": {"rps_mean": 39.0}})
+    assert _run(tmp_path) == 0
+    out = capsys.readouterr().out
+    assert "soak: n/a" in out  # only one plain soak artifact
+    assert ("grow-soak: grow-soak-20260801-010000.json -> "
+            "grow-soak-20260805-010000.json") in out

@@ -1,0 +1,159 @@
+"""The flagship composition's pure planes: arrival traces, tier→frontend
+placement, and merged cross-process telemetry.
+
+Each is a pure function the distributed campaign leans on — placement
+must agree across every process with zero coordination, traces must be
+byte-replayable from (spec, seed) alone, and the history merge must
+keep per-process gaps visible instead of silently deflating fleet
+rates — so the contracts are pinned here without any live server.
+"""
+
+import pytest
+
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.protocol.tiers import (
+    frontend_for,
+    iter_tier_nodes,
+    tier_placement,
+)
+from sda_tpu.telemetry.timeseries import merge_histories
+from sda_tpu.utils.arrivals import ArrivalTrace, parse_trace
+
+
+def _tiered_root(tiers=2, m=4):
+    # fixed root id: placement assertions stay deterministic run to run
+    return Aggregation(
+        id=AggregationId("11111111-2222-3333-4444-555555555555"),
+        title="flagship placement",
+        vector_dimension=4,
+        modulus=433,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=ChaChaMasking(modulus=433, dimension=4,
+                                     seed_bitsize=128),
+        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+        sub_cohort_size=m,
+        tiers=tiers,
+    )
+
+
+# -- arrival traces --
+
+
+def test_trace_parses_and_replays_byte_identically():
+    a = ArrivalTrace.from_text("base=50,diurnal=0.8@30,burst=0.1@8,churn=0.2:42")
+    b = ArrivalTrace.from_text("base=50,diurnal=0.8@30,burst=0.1@8,churn=0.2:42")
+    assert a.times(200) == b.times(200)
+    assert [a.is_churned(i) for i in range(200)] == [
+        b.is_churned(i) for i in range(200)
+    ]
+    assert [a.is_burst_slot(s) for s in range(60)] == [
+        b.is_burst_slot(s) for s in range(60)
+    ]
+
+
+def test_trace_seed_changes_the_sequence():
+    a = ArrivalTrace.from_text("base=50,burst=0.3:1")
+    b = ArrivalTrace.from_text("base=50,burst=0.3:2")
+    assert a.times(50) != b.times(50)
+
+
+def test_trace_times_are_strictly_increasing_and_rate_shaped():
+    trace = ArrivalTrace.from_text("base=100:7")
+    ts = trace.times(500)
+    assert all(b > a for a, b in zip(ts, ts[1:]))
+    # 500 arrivals at 100/s should land near 5s — a loose envelope, but
+    # it catches a rate that is off by a power of ten
+    assert 2.0 < ts[-1] < 12.5
+
+
+def test_trace_churn_moves_when_never_whether():
+    """Churn defers uploads; it must not change the arrival count or the
+    non-churn draw sequence (disjoint index spaces per rule)."""
+    plain = ArrivalTrace.from_text("base=40:9")
+    churny = ArrivalTrace.from_text("base=40,churn=0.5:9")
+    assert plain.times(100) == churny.times(100)
+    flags = [churny.is_churned(i) for i in range(400)]
+    assert 0.3 < sum(flags) / len(flags) < 0.7
+
+
+def test_trace_rejects_garbage():
+    for bad in ("", "base=", "wat=3", "base=10,diurnal=2.0", "base=-1"):
+        with pytest.raises(ValueError):
+            parse_trace(bad)
+
+
+# -- tier -> frontend placement --
+
+
+def test_frontend_for_is_pure_and_in_range():
+    root = _tiered_root()
+    for node in iter_tier_nodes(root):
+        ix = frontend_for(node.aggregation_id, 3)
+        assert 0 <= ix < 3
+        assert ix == frontend_for(node.aggregation_id, 3)
+
+
+def test_tier_placement_covers_the_whole_tree_and_agrees():
+    root = _tiered_root(tiers=3, m=2)
+    placement = tier_placement(root, 3)
+    nodes = iter_tier_nodes(root)
+    assert set(placement) == {n.aggregation_id for n in nodes}
+    assert len(nodes) == 1 + 2 + 4
+    for node_id, ix in placement.items():
+        assert ix == frontend_for(node_id, 3)
+
+
+def test_tier_placement_single_frontend_is_all_zero():
+    placement = tier_placement(_tiered_root(), 1)
+    assert set(placement.values()) == {0}
+
+
+def test_placement_spreads_across_frontends():
+    """Not a balance guarantee, but a 21-node tree that lands entirely on
+    one of 3 frontends means the ring is broken, not unlucky."""
+    placement = tier_placement(_tiered_root(tiers=3, m=4), 3)
+    assert len(set(placement.values())) >= 2
+
+
+# -- merged cross-process telemetry --
+
+
+def _sample(t, rps, p99, procs_unused=None):
+    return {
+        "t": t,
+        "dt_s": 1.0,
+        "rss_mib": 50.0,
+        "routes": {"/v1/ping": {"rps": rps, "p50_s": p99 / 2,
+                                "p95_s": p99, "p99_s": p99}},
+    }
+
+
+def test_merge_histories_sums_rates_and_maxes_quantiles():
+    a = [_sample(10.0, 5.0, 0.010), _sample(11.0, 5.0, 0.010)]
+    b = [_sample(10.2, 3.0, 0.030)]
+    merged = merge_histories([{"samples": a, "interval_s": 1.0},
+                              {"samples": b, "interval_s": 1.0}])
+    assert [s["procs"] for s in merged] == [2, 1]
+    both = merged[0]["routes"]["/v1/ping"]
+    assert both["rps"] == pytest.approx(8.0)
+    assert both["p99_s"] == pytest.approx(0.030)  # slowest process wins
+    # the second bucket only saw process a — the gap stays visible
+    assert merged[1]["routes"]["/v1/ping"]["rps"] == pytest.approx(5.0)
+
+
+def test_merge_histories_accepts_bare_sample_lists():
+    merged = merge_histories([[_sample(1.0, 2.0, 0.001)],
+                              [_sample(1.3, 4.0, 0.002)]], bucket_s=1.0)
+    assert len(merged) == 1 and merged[0]["procs"] == 2
+    assert merged[0]["rss_mib"] == pytest.approx(100.0)  # fleet RSS sums

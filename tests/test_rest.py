@@ -177,6 +177,85 @@ def test_malformed_requests_are_400s_not_500s(http_ctx):
     assert r.status_code == 401
 
 
+def test_clerking_result_route_job_must_match_body(http_ctx):
+    """POST /v1/aggregations/implied/jobs/{id}/result: the body's job id
+    must equal the route's. A mismatched body used to be filed under the
+    BODY's job while every route-derived expectation pointed at the
+    route's — results could be planted on a job the URL never named."""
+    import json
+
+    _, base_url, tmp_path = http_ctx
+    service = SdaHttpClient(base_url, TokenStore(tmp_path / "tokens"))
+
+    recipient = new_client(tmp_path / "recipient", service)
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="route-body-mismatch",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    clerks = [new_client(tmp_path / f"clerk{i}", service) for i in range(2)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+    recipient.begin_aggregation(agg.id)
+    part = new_client(tmp_path / "part", service)
+    part.upload_agent()
+    part.participate([1, 2, 3, 4], agg.id)
+    recipient.end_aggregation(agg.id)
+
+    jobs = [c.service.get_clerking_job(c.agent, c.agent.id) for c in clerks]
+    assert all(j is not None for j in jobs)
+    results = [c.process_clerking_job(j) for c, j in zip(clerks, jobs)]
+
+    token = TokenStore(tmp_path / "tokens").get()
+    auth = (str(clerks[0].agent.id), token)
+    body = json.dumps(results[0].to_json())
+
+    # clerk 0's valid result posted to clerk 1's job route -> 400
+    r = requests.post(
+        f"{base_url}/v1/aggregations/implied/jobs/{jobs[1].id}/result",
+        data=body, auth=auth, headers={"Content-Type": "application/json"},
+    )
+    assert r.status_code == 400 and str(jobs[1].id) in r.text
+
+    # ...and to a route naming a job that does not exist at all -> 400
+    r = requests.post(
+        f"{base_url}/v1/aggregations/implied/jobs/{AggregationId.random()}/result",
+        data=body, auth=auth, headers={"Content-Type": "application/json"},
+    )
+    assert r.status_code == 400
+
+    # a consistent body+route for a job the CALLER does not own -> 403
+    auth1 = (str(clerks[1].agent.id), token)
+    r = requests.post(
+        f"{base_url}/v1/aggregations/implied/jobs/{jobs[0].id}/result",
+        data=body, auth=auth1, headers={"Content-Type": "application/json"},
+    )
+    assert r.status_code == 403
+
+    # the matched route still works, and the round completes exactly
+    r = requests.post(
+        f"{base_url}/v1/aggregations/implied/jobs/{jobs[0].id}/result",
+        data=body, auth=auth, headers={"Content-Type": "application/json"},
+    )
+    assert r.status_code == 201
+    clerks[1].service.create_clerking_result(clerks[1].agent, results[1])
+    recipient.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id)
+    np.testing.assert_array_equal(out.positive().values, [1, 2, 3, 4])
+
+
 # The reference's full route table, transcribed from
 # /root/reference/server-http/src/lib.rs:136-171 (router! macro) — one
 # (method, path-template) per RPC. {u} marks a uuid path segment.

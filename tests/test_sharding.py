@@ -116,6 +116,39 @@ def test_hashring_deterministic_balanced():
         HashRing(0)
 
 
+@pytest.mark.parametrize("shards", [1, 2, 4, 8])
+def test_hashring_grow_moves_bounded_fraction(shards):
+    """Consistent-hashing elasticity: growing K -> K+1 must move only
+    the keys the new shard's vnodes capture — about 1/(K+1) of them —
+    never trigger a wholesale reshuffle (the mod-K failure mode)."""
+    from sda_tpu.utils.hashring import HashRing
+
+    old, grown = HashRing(shards), HashRing(shards + 1)
+    keys = [str(uuid.UUID(int=i * 104729)) for i in range(2000)]
+    moved = sum(1 for k in keys if old.shard_for(k) != grown.shard_for(k))
+    ideal = len(keys) / (shards + 1)
+    assert moved <= 1.8 * ideal, (moved, ideal)
+    # every moved key must land on the NEW shard: old shards never trade
+    # keys among themselves during a grow
+    for k in keys:
+        if old.shard_for(k) != grown.shard_for(k):
+            assert grown.shard_for(k) == shards
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_hashring_grow_preserves_surviving_preference_order(shards):
+    """The grown ring's preference walk is the old walk with the new
+    shard spliced in: surviving shards keep their relative order for
+    every key, so R-replica sets only ever change by the new member."""
+    from sda_tpu.utils.hashring import HashRing
+
+    old, grown = HashRing(shards), HashRing(shards + 1)
+    for i in range(500):
+        k = str(uuid.UUID(int=i * 7919 + 13))
+        survivors = [ix for ix in grown.preference(k) if ix != shards]
+        assert survivors == old.preference(k), k
+
+
 # -- equivalence matrix -----------------------------------------------------
 
 
@@ -257,6 +290,76 @@ def test_frontend_failover_mid_round(tmp_path):
                 h.server_close()
             except Exception:
                 pass
+
+
+# -- elastic scale-out ------------------------------------------------------
+
+
+@pytest.mark.parametrize("replicas", [1, 2])
+@pytest.mark.parametrize("shards", [1, 2])
+@pytest.mark.parametrize("pre_snap", [False, True])
+@pytest.mark.parametrize("clerk_mid", [False, True])
+def test_live_shard_grow_reveals_exact(
+    tmp_path, shards, replicas, pre_snap, clerk_mid
+):
+    """A shard add in the MIDDLE of a live round — before or after the
+    snapshot cut, with clerking either during the migration window or
+    after the ring flip — must drain its handoff queue to zero and
+    reveal byte-exactly. This is the add_shard / migrate_once /
+    finish_add_shard protocol driven step-by-step (repair thread
+    stopped) across the K x R x phase matrix."""
+    from sda_tpu.server import new_sharded_server
+
+    svc = new_sharded_server("mem", shards, replicas=replicas)
+    router = svc.shard_router
+    router.stop_repair()  # deterministic stepping: we drain explicitly
+    recipient, clerks, agg = _open_aggregation(tmp_path, svc)
+    participant = new_client(tmp_path / "p", svc)
+    participant.upload_agent()
+    participant.upload_participations(
+        participant.new_participations(VALUES, agg.id)
+    )
+    if pre_snap:
+        recipient.end_aggregation(agg.id)
+    new_ix = router.add_shard()
+    assert new_ix == shards
+    router.migrate_once()
+    if not pre_snap:
+        recipient.end_aggregation(agg.id)
+    if clerk_mid:
+        # clerks work the queues while the union write set is live
+        for c in clerks:
+            c.run_chores(-1)
+        router.finish_add_shard()
+    else:
+        router.finish_add_shard()
+        for c in clerks:
+            c.run_chores(-1)
+    assert router.hint_depth() == 0
+    assert router.shards == shards + 1
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    assert [int(v) for v in out] == EXPECTED
+
+
+def test_grow_convenience_returns_new_index(tmp_path):
+    """``grow()`` = add + migrate + finish in one call; rounds opened
+    BEFORE the grow stay revealable through the grown ring."""
+    from sda_tpu.server import new_sharded_server
+
+    svc = new_sharded_server("mem", 2, replicas=2)
+    recipient, clerks, agg = _open_aggregation(tmp_path, svc)
+    participant = new_client(tmp_path / "p", svc)
+    participant.upload_agent()
+    participant.upload_participations(
+        participant.new_participations(VALUES, agg.id)
+    )
+    assert svc.shard_router.grow(timeout=30.0) == 2
+    recipient.end_aggregation(agg.id)
+    for c in clerks:
+        c.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive().values
+    assert [int(v) for v in out] == EXPECTED
+    assert svc.shard_router.hint_depth() == 0
 
 
 # -- admission control ------------------------------------------------------

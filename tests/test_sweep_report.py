@@ -331,6 +331,50 @@ def test_scenario_survivability_section(tmp_path, capsys):
     assert "retry-layer overhead A/B: -0.10%" in out and "1000 requests/arm" in out
 
 
+def test_flagship_campaign_section(tmp_path, capsys):
+    _write(tmp_path, "flagship-20260806-010000.json",
+           {"kind": "flagship",
+            "topology": {"frontend_processes": 3, "shards": 2,
+                         "replicas": 2, "tiers": 2, "fanout": 4},
+            "trace": "base=300,burst=0.25@6,churn=0.15:64",
+            "simulated_population": 1_000_000,
+            "certified_max_cohort": 512, "scale_factor": 1953.1,
+            "ladder": [
+                {"rung": 0, "cohort": 256, "round_s": 8.0,
+                 "certified": True},
+                {"rung": 1, "cohort": 512, "round_s": 16.0,
+                 "certified": True},
+                {"rung": 2, "cohort": 1024, "round_s": 90.0,
+                 "certified": False},
+            ],
+            "merged_samples": [{"t": 1.0, "procs": 2}, {"t": 2.0, "procs": 3}],
+            "campaign_s": 41.5})
+    _write(tmp_path, "flagship-broken.json", {"note": "not a campaign"})
+    # the grow-soak variant rides the soak section via its own glob
+    _write(tmp_path, "grow-soak-20260806-010000.json",
+           {"kind": "soak",
+            "config": {"duration_s": 30.0, "rate": 20.0},
+            "total_rounds": 4, "exact_rounds": 4,
+            "samples": [{"t": 1.0}],
+            "summary": {"rps_mean": 21.0, "rps_max": 25.0,
+                        "rss_mib": {"start": 40.0, "end": 41.0,
+                                    "peak": 41.5}}})
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "flagship campaigns" in out
+    assert "3fx2sx2r" in out     # topology collapses to NfxKsxRr
+    assert "512" in out          # the certified-cohort headline
+    assert "2/3" in out          # rungs certified / attempted
+    assert "32.0" in out         # peak certified cohort/s = 512/16.0
+    assert "flagship-broken.json" not in out
+    assert "grow-soak-20260806-010000.json" in out  # soak section variant
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]
