@@ -2654,6 +2654,202 @@ def measure_tier_fanout(n_participants: int | None = None) -> dict:
     return out
 
 
+def _emit_sketch_line(tag: str, value, unit: str, extra: dict) -> None:
+    """One rider line per sketch-accuracy leg (same interim-line contract
+    as the other protocol-plane riders)."""
+    line = {
+        "metric": f"sketch_{tag}",
+        "value": value,
+        "unit": unit,
+        "trace_id": RUN_TRACE_ID,
+        **extra,
+    }
+    print(json.dumps(line), flush=True)
+
+
+def measure_sketch_accuracy() -> dict:
+    """Sketch-plane rider: accuracy vs wire dimension for the workload
+    library (sda_tpu/sketches), each leg one full secure round over a
+    live loopback REST server.
+
+    Two dimension sweeps at fixed seeds and fixed data:
+
+    - **count-min** at widths {64, 256, 1024} (depth 4): max point-query
+      error over the whole domain against the analytic eps*N bound —
+      the accuracy-vs-dimension tradeoff the recipient actually tunes;
+    - **linear-counting cardinality** at m in {256, 1024, 4096}: the
+      relative estimate error against the 3-sigma bound.
+
+    Every leg's securely-aggregated sketch is asserted BYTE-IDENTICAL to
+    the central numpy sum of the per-phone sketches before its numbers
+    count (the protocol may never trade exactness for speed), and
+    ``bound_headroom`` (analytic bound / observed error, >= 1 means
+    within bound) is the gateable accuracy metric — shrinking headroom
+    at fixed seeds means someone broke the estimator, not noise.
+    Throughput is encoded items per wall second through the full stack
+    (honest single-core note applies: everything timeshares one CPU)."""
+    import tempfile
+
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import AdditiveSharing
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+    from sda_tpu.sketches import CountMinSketch, LinearCountingSketch, SketchQuery
+
+    seed = 20260806
+    n_phones, n_clerks = 4, 3
+    domain = 128
+    rng = np.random.default_rng(seed)
+    # skewed categorical streams: 3 planted heavy hitters per phone
+    cm_data = [
+        [int(h) for h in (3, 17, 41) for _ in range(30)]
+        + [int(v) for v in rng.integers(0, domain, size=60)]
+        for _ in range(n_phones)
+    ]
+    from collections import Counter
+
+    cm_true = Counter(x for d in cm_data for x in d)
+    cm_total = sum(len(d) for d in cm_data)
+    distinct = [f"device-{i}" for i in range(200)]
+    lc_data = [distinct[i::n_phones] + distinct[:40] for i in range(n_phones)]
+    lc_true = len(distinct)
+
+    out: dict = {"families": {"countmin": {"legs": {}}, "cardinality": {"legs": {}}}}
+
+    with tempfile.TemporaryDirectory() as tmp, serve_background(
+        new_mem_server()
+    ) as url:
+        tmpp = pathlib.Path(tmp)
+        service = SdaHttpClient(url, TokenStore(str(tmpp / "tokens")))
+
+        def mk(name):
+            ks = Keystore(str(tmpp / name))
+            client = SdaClient(SdaClient.new_agent(ks), ks, service)
+            client.upload_agent()
+            return client
+
+        recipient = mk("r")
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [mk(f"c{i}") for i in range(n_clerks)]
+        for c in clerks:
+            c.upload_encryption_key(c.new_encryption_key())
+        phones = [mk(f"p{i}") for i in range(n_phones)]
+
+        def run_leg(sketch, datasets, title):
+            query = SketchQuery(
+                sketch, n_participants=8,
+                max_values_per_participant=1 << 10,
+            )
+            sharing = AdditiveSharing(
+                share_count=n_clerks, modulus=query.spec.modulus
+            )
+            t0 = time.perf_counter()
+            agg = query.open_round(recipient, rkey, sharing, title=title)
+            for phone, values in zip(phones, datasets):
+                query.submit(phone, agg, values)
+            query.close_round(recipient, agg)
+            for w in [recipient] + clerks:
+                w.run_chores(-1)
+            summed = query.finish(recipient, agg, len(datasets))
+            wall = time.perf_counter() - t0
+            expected = sum(query.local_sketch(d) for d in datasets)
+            assert summed.tobytes() == expected.tobytes(), (
+                f"{title}: secure sum != central sum"
+            )
+            return summed, wall
+
+        for width in (64, 256, 1024):
+            cm = CountMinSketch(width=width, depth=4, seed=seed)
+            summed, wall = run_leg(cm, cm_data, f"bench-countmin-w{width}")
+            bound = cm.error_bound(summed)
+            errs = [
+                cm.point_query(summed, x) - cm_true[x] for x in range(domain)
+            ]
+            max_err = float(max(errs))
+            leg = {
+                "dim": cm.dim,
+                "width": width,
+                "depth": 4,
+                "wall_s": round(wall, 3),
+                "items_per_s": round(cm_total / wall),
+                "total": cm_total,
+                "max_err": max_err,
+                "bound": round(bound, 2),
+                "within_bound": bool(max_err <= bound),
+                # observed errors can be 0 at large widths: floor at one
+                # count so headroom stays finite and comparable
+                "bound_headroom": round(bound / max(max_err, 1.0), 3),
+                "byte_exact": True,
+            }
+            out["families"]["countmin"]["legs"][f"w{width}"] = leg
+            _emit_sketch_line(
+                f"countmin_w{width}", leg["max_err"], "counts_abs_err",
+                {
+                    "dim": leg["dim"], "bound": leg["bound"],
+                    "within_bound": leg["within_bound"],
+                    "items_per_s": leg["items_per_s"],
+                    "wall_s": leg["wall_s"],
+                },
+            )
+
+        for m in (256, 1024, 4096):
+            lc = LinearCountingSketch(m=m, seed=seed)
+            summed, wall = run_leg(lc, lc_data, f"bench-cardinality-m{m}")
+            dec = lc.decode(summed, n_phones)
+            err = abs(dec["estimate"] - lc_true)
+            leg = {
+                "dim": m,
+                "wall_s": round(wall, 3),
+                "items_per_s": round(sum(len(d) for d in lc_data) / wall),
+                "true": lc_true,
+                "estimate": round(dec["estimate"], 1),
+                "abs_err": round(err, 1),
+                "bound": round(dec["error_bound"], 1),
+                "within_bound": bool(err <= dec["error_bound"]),
+                "bound_headroom": round(dec["error_bound"] / max(err, 1.0), 3),
+                "byte_exact": True,
+            }
+            out["families"]["cardinality"]["legs"][f"m{m}"] = leg
+            _emit_sketch_line(
+                f"cardinality_m{m}", leg["abs_err"], "distinct_abs_err",
+                {
+                    "dim": m, "bound": leg["bound"],
+                    "within_bound": leg["within_bound"],
+                    "items_per_s": leg["items_per_s"],
+                    "wall_s": leg["wall_s"],
+                },
+            )
+
+    # -- artifact ----------------------------------------------------------
+    payload = {
+        "metric": "sketch_accuracy",
+        "config": {
+            "n_phones": n_phones,
+            "seed": seed,
+            "committee": f"additive x{n_clerks}",
+            "store": "mem",
+            "transport": "loopback_rest",
+            "cpu_count": os.cpu_count(),
+            "multi_core_host": (os.cpu_count() or 1) > 1,
+        },
+        **out,
+    }
+    if os.environ.get("SDA_BENCH_ARTIFACTS") == "0":
+        return out  # test harness: stdout evidence only, no repo litter
+    here = pathlib.Path(__file__).resolve().parent / "bench-artifacts"
+    try:
+        here.mkdir(exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        (here / f"sketch-{stamp}.json").write_text(json.dumps(payload, indent=2))
+    except OSError as exc:  # read-only checkout: keep the stdout evidence
+        print(f"[bench] sketch artifact not written: {exc}", file=sys.stderr)
+    return out
+
+
 def measure_tpu_parity() -> dict:
     """On-device bit-parity of every accelerated plane against its host
     oracle (VERDICT r1 #2: the Pallas/jnp device paths had only ever run
@@ -3659,6 +3855,11 @@ def main() -> int:
                 _CRYPTO_STATS["tier"] = measure_tier_fanout()
         except Exception as exc:
             print(f"[bench] tier-fanout rider failed: {exc}", file=sys.stderr)
+        try:
+            with stage("sketch-accuracy rider"):
+                _CRYPTO_STATS["sketch"] = measure_sketch_accuracy()
+        except Exception as exc:
+            print(f"[bench] sketch-accuracy rider failed: {exc}", file=sys.stderr)
     # fail fast on an unreachable backend: the wedged-tunnel failure mode
     # (the axon relay can block jax.devices() for hours) would otherwise
     # eat the whole --deadline before the watchdog reports it. The probe

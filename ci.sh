@@ -24,7 +24,10 @@
 #      (scripts/flagship.py --smoke): a tiny certified-cohort ladder
 #      over 2 sdad OS processes x 2 shards x R=2 whose artifact must
 #      certify at least the first rung and carry a merged cross-process
-#      telemetry series that actually saw both frontends
+#      telemetry series that actually saw both frontends; then the
+#      sketch-plane smoke (examples/sketch_suite.py over REST + sqlite):
+#      all five sketch families must decode inside their analytic
+#      bounds, re-checked from the banked JSON
 #   4. examples/ — both runnable end-to-end demos (federated training,
 #      federated analytics) must keep running as documented
 #   5. scripts/scenarios.py — churn-scenario smoke over the real REST
@@ -129,6 +132,41 @@ print(f"ci: flagship certified cohort {d['certified_max_cohort']} "
       f"({len(merged)} merged buckets, peak {peak} procs)")
 EOF
 rm -rf "$FLAG_ART"
+
+echo "=== ci 3d/6: sketch-plane smoke (workload suite over REST + sqlite) ==="
+# the five-family federated-analytics suite (count-min, count-sketch,
+# dyadic quantiles, linear counting, top-k) through the live REST stack
+# on the sqlite store: every secure sum is asserted byte-identical to
+# the central sum inside the suite, and the banked summary must put the
+# recovered heavy-hitter set and every decoded estimate inside its
+# stated analytic error bound — re-checked here from the JSON alone, so
+# a suite that stops asserting cannot pass silently
+SKETCH_ART="$(mktemp -d)"
+JAX_PLATFORMS=cpu python examples/sketch_suite.py --store sqlite \
+    --json "$SKETCH_ART/suite.json"
+python - "$SKETCH_ART/suite.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+cm = d["countmin"]
+for app, est in cm["hits"].items():
+    true = cm["true"][app]
+    assert true <= est <= true + cm["bound"], (app, est, true, cm["bound"])
+cs = d["countsketch"]
+for app, est in cs["estimates"].items():
+    assert abs(est - cs["true"][app]) <= cs["bound"], (app, est, cs)
+qt = d["quantiles"]
+assert qt["ranks"], "no quantile rank evidence banked"
+for q, r in qt["ranks"].items():
+    assert r["lo"] - qt["rank_bound"] <= r["target"] <= r["hi"] + qt["rank_bound"], (q, r, qt["rank_bound"])
+lc = d["cardinality"]
+assert abs(lc["estimate"] - lc["true"]) <= lc["bound"], lc
+tk = d["topk"]
+got = {a for a, _ in tk["topk"]}
+assert got == set(tk["true_hot"]), (got, tk["true_hot"])
+print(f"ci: sketch suite decoded all five families inside bounds "
+      f"(store={d['store']}, top-{len(tk['topk'])} = {sorted(got)})")
+EOF
+rm -rf "$SKETCH_ART"
 
 echo "=== ci 4/6: runnable examples (user-facing docs must not rot) ==="
 python examples/federated_training.py >/dev/null

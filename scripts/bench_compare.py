@@ -147,6 +147,33 @@ def _metrics_flagship(d: dict) -> dict:
     return out
 
 
+def _metrics_sketch(d: dict) -> dict:
+    """sketch-*: accuracy AND throughput, both shaped higher-is-better
+    so the generic delta logic gates them. Per leg (one sketch family at
+    one wire dimension): ``bound_headroom`` = analytic error bound /
+    observed error (>= 1 means the decode landed within its stated
+    bound; data and seeds are pinned, so a drop means the estimator —
+    not the noise — changed). Per family: the best encode-to-reveal
+    items/s across dimensions."""
+    out = {}
+    fams = d.get("families") if isinstance(d.get("families"), dict) else {}
+    for fam, body in fams.items():
+        legs = body.get("legs") if isinstance(body, dict) else None
+        if not isinstance(legs, dict):
+            continue
+        rates = []
+        for tag, leg in legs.items():
+            if not isinstance(leg, dict):
+                continue
+            if isinstance(leg.get("bound_headroom"), (int, float)):
+                out[f"{fam}_{tag}_bound_headroom"] = float(leg["bound_headroom"])
+            if isinstance(leg.get("items_per_s"), (int, float)):
+                rates.append(float(leg["items_per_s"]))
+        if rates:
+            out[f"{fam}_best_items_per_s"] = max(rates)
+    return out
+
+
 #: family -> (glob, throughput extractor); sorted() over the stamped
 #: names is chronological, so [-1] is newest and [-2] its predecessor
 RIDERS = {
@@ -164,6 +191,7 @@ RIDERS = {
     "replication": ("replication-*.json", _metrics_shard),
     "tier": ("tier-*.json", _metrics_tier),
     "flagship": ("flagship-*.json", _metrics_flagship),
+    "sketch": ("sketch-*.json", _metrics_sketch),
 }
 
 

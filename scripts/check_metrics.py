@@ -83,6 +83,9 @@ REQUIRED_SERIES = [
     # promotion counter and the depth gauge must both show
     "sda_tier_promotions_total",
     "sda_tier_depth",
+    # workload plane: drive_sketch_round completes one count-min round
+    # through SketchQuery, which ticks the per-family round counter
+    "sda_workload_rounds_total",
 ]
 
 
@@ -221,6 +224,58 @@ def drive_tier_round(base_url: str, tmp: str) -> None:
     status = recipient.service.get_tier_status(recipient.agent, agg.id)
     assert status is not None and all(n.result_ready for n in status.nodes), \
         "tier status route disagrees with the finished round"
+
+
+def drive_sketch_round(base_url: str, tmp: str) -> None:
+    """One count-min round through the sketch-plane driver (SketchQuery
+    riding FederatedAveraging at frac_bits=0) over the live REST stack,
+    so the workload plane's series —
+    ``sda_workload_rounds_total{workload="countmin"}`` — appears in the
+    scrape and the sketch library runs against real HTTP once per CI
+    pass. Runs FIRST on the fresh server: SketchQuery elects its
+    committee from the candidate pool, so earlier legs' clerks (who
+    never run chores here) must not be candidates yet."""
+    import numpy as np
+
+    from sda_tpu.client import SdaClient
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import AdditiveSharing
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+    from sda_tpu.sketches import CountMinSketch, SketchQuery
+
+    def new_client(subdir):
+        keystore = Keystore(os.path.join(tmp, subdir))
+        service = SdaHttpClient(base_url, TokenStore(os.path.join(tmp, subdir)))
+        return SdaClient(SdaClient.new_agent(keystore), keystore, service)
+
+    recipient = new_client("sk-recipient")
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    clerks = [new_client(f"sk-clerk{i}") for i in range(3)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+
+    cm = CountMinSketch(width=16, depth=2, seed=3)
+    query = SketchQuery(cm, n_participants=3, max_values_per_participant=8)
+    agg = query.open_round(
+        recipient, rkey,
+        AdditiveSharing(share_count=3, modulus=query.spec.modulus),
+        title="check-metrics-sketch",
+    )
+    datasets = [["a", "b"], ["a", "c"], ["a", "b", "c"]]
+    for i, values in enumerate(datasets):
+        phone = new_client(f"sk-phone{i}")
+        phone.upload_agent()
+        query.submit(phone, agg, values)
+    query.close_round(recipient, agg)
+    for w in [recipient] + clerks:  # the recipient may hold a seat too
+        w.run_chores(-1)
+    summed = query.finish(recipient, agg, len(datasets))
+    expected = sum(query.local_sketch(d) for d in datasets)
+    assert summed.tobytes() == np.asarray(expected).tobytes(), \
+        "sketch workload sum disagrees"
 
 
 def drive_faulted_leg(base_url: str, tmp: str) -> None:
@@ -395,6 +450,7 @@ def main() -> int:
 
     server = new_mem_server()
     with serve_background(server) as base_url, tempfile.TemporaryDirectory() as tmp:
+        drive_sketch_round(base_url, tmp)  # first: elects from candidates
         with telemetry.trace("ci-check-metrics"):
             drive_workload(base_url, tmp)
         drive_tier_round(base_url, tmp)

@@ -131,7 +131,29 @@ def stop(procs: list) -> None:
 # -- rounds ------------------------------------------------------------------
 
 
-def rung_values(rung: int, cohort: int) -> list:
+#: the sketch workload's shared count-min shape: every phone encodes its
+#: private items into these fat columns, and the tiered plane certifies
+#: the summed grid exactly like the dense control (dim 128 instead of 4)
+WORKLOAD_SKETCH_SHAPE = {"width": 32, "depth": 4, "seed": 7}
+
+
+def _workload_sketch():
+    from sda_tpu.sketches import CountMinSketch
+
+    return CountMinSketch(**WORKLOAD_SKETCH_SHAPE)
+
+
+def workload_items(rung: int, i: int) -> list:
+    """Phone i's private items for one rung — app-0 dominates the
+    cohort-wide counts, so the decoded grid has a known heavy hitter."""
+    return [f"app-{(rung + i) % 6}", f"app-{i % 9}", f"app-{(3 * i) % 13}"]
+
+
+def rung_values(rung: int, cohort: int, workload: str = "dense") -> list:
+    if workload == "sketch":
+        cm = _workload_sketch()
+        return [[int(c) for c in cm.encode(workload_items(rung, i))]
+                for i in range(cohort)]
     return [[(rung + i) % 11, i % 7, 1, (3 * i) % 5] for i in range(cohort)]
 
 
@@ -253,7 +275,7 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
             f"placement disagrees for node {tn.aggregation.id}"
         )
 
-    values = rung_values(rung, cohort)
+    values = rung_values(rung, cohort, ctx["workload"])
     # the cohort arrives on the trace: each upload waits for its arrival
     # time; churned phones disconnect and retry at the end of the round
     deferred = []
@@ -284,7 +306,7 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
     flat = flat_baseline(values)
     flat_match = out.values.tobytes() == flat
     elapsed = time.perf_counter() - t0
-    return {
+    r = {
         "rung": rung,
         "cohort": cohort,
         "churned": len(deferred),
@@ -299,6 +321,29 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
         },
         "_elapsed": elapsed,
     }
+    if ctx["workload"] == "sketch":
+        # the certified grid must also DECODE: count-min never
+        # undercounts (guaranteed, so asserted), and the one-sided
+        # overshoot vs the analytic bound is recorded per rung
+        from collections import Counter
+
+        cm = _workload_sketch()
+        grid = np.asarray([int(x) for x in out.values], dtype=np.int64)
+        true = Counter(
+            it for i in range(cohort) for it in workload_items(rung, i)
+        )
+        hot, hot_true = true.most_common(1)[0]
+        est = int(cm.point_query(grid, hot))
+        bound = cm.error_bound(grid)
+        assert est >= hot_true, f"count-min undercounted {hot}"
+        r["sketch"] = {
+            "hot_item": hot,
+            "true": hot_true,
+            "estimate": est,
+            "bound": round(bound, 2),
+            "within_bound": bool(est <= hot_true + bound),
+        }
+    return r
 
 
 # -- merged fleet telemetry --------------------------------------------------
@@ -341,6 +386,11 @@ def main() -> int:
     ap.add_argument("--tiers", type=int, default=2, metavar="T")
     ap.add_argument("--fanout", type=int, default=4, metavar="M",
                     help="sub-cohorts per node (default 4)")
+    ap.add_argument("--workload", choices=["dense", "sketch"], default="dense",
+                    help="rung payload: the dense 4-wide control vectors, "
+                         "or each phone's count-min sketch columns "
+                         "(dim 128) certified and decoded per rung "
+                         "(default dense)")
     ap.add_argument("--trace",
                     default="base=200,diurnal=0.6@20,burst=0.15@4,churn=0.1:16",
                     help="arrival trace spec (sda_tpu.utils.arrivals)")
@@ -379,10 +429,16 @@ def main() -> int:
 
     from sda_tpu.utils.arrivals import ArrivalTrace
 
+    global DIM
+    if args.workload == "sketch":
+        DIM = _workload_sketch().dim  # fat columns on the whole data path
+
     t_start = time.perf_counter()
     procs: list = []
     record: dict = {
         "kind": "flagship",
+        "workload": args.workload,
+        "vector_dimension": DIM,
         "topology": {
             "frontend_processes": args.frontends,
             "shards": args.shards,
@@ -441,6 +497,7 @@ def main() -> int:
                 "recipient": recipient, "rkey": rkey,
                 "pool": pool, "participants": participants,
                 "tiers": args.tiers, "fanout": args.fanout,
+                "workload": args.workload,
                 "trace": ArrivalTrace.from_text(args.trace),
                 "cursor": {"index": 0, "t": 0.0, "t0": time.perf_counter()},
                 "poll_timeout": max(60.0, args.rung_deadline),

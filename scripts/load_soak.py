@@ -77,6 +77,32 @@ import numpy as np  # noqa: E402
 DIM = 4
 MODULUS = 100003
 
+#: the sketch workload's shared count-min shape (same as flagship.py):
+#: each phone's payload is its encoded grid — dim 128 instead of 4, so
+#: the soak pushes the sketch plane's fat columns through ingest, the
+#: paged clerking pipeline, and reveal at the pinned arrival rate
+WORKLOAD_SKETCH_SHAPE = {"width": 32, "depth": 4, "seed": 7}
+
+
+def _workload_sketch():
+    from sda_tpu.sketches import CountMinSketch
+
+    return CountMinSketch(**WORKLOAD_SKETCH_SHAPE)
+
+
+def workload_items(ix: int, i: int) -> list:
+    """Phone i's private items for round ix — app-0 dominates the
+    round-wide counts, so the decoded grid has a known heavy hitter."""
+    return [f"app-{(ix + i) % 6}", f"app-{i % 9}", f"app-{(3 * i) % 13}"]
+
+
+def workload_values(ix: int, n: int, workload: str) -> list:
+    if workload == "sketch":
+        cm = _workload_sketch()
+        return [[int(c) for c in cm.encode(workload_items(ix, i))]
+                for i in range(n)]
+    return [[(ix + i) % 11, i % 7, 1, (3 * i) % 5] for i in range(n)]
+
 
 def build_stack(tmp: pathlib.Path, roots):
     """Recipient + committee + one pinned-rate participant, registered
@@ -105,7 +131,7 @@ def build_stack(tmp: pathlib.Path, roots):
     return recipient, rkey, clerks, participant
 
 
-def new_round_aggregation(recipient, rkey, clerks, tag: str):
+def new_round_aggregation(recipient, rkey, clerks, tag: str, dim: int = DIM):
     from sda_tpu.protocol import (
         AdditiveSharing,
         Aggregation,
@@ -117,12 +143,12 @@ def new_round_aggregation(recipient, rkey, clerks, tag: str):
     agg = Aggregation(
         id=AggregationId.random(),
         title=f"soak-{tag}",
-        vector_dimension=DIM,
+        vector_dimension=dim,
         modulus=MODULUS,
         recipient=recipient.agent.id,
         recipient_key=rkey,
         masking_scheme=ChaChaMasking(
-            modulus=MODULUS, dimension=DIM, seed_bitsize=128
+            modulus=MODULUS, dimension=dim, seed_bitsize=128
         ),
         committee_sharing_scheme=AdditiveSharing(
             share_count=len(clerks), modulus=MODULUS
@@ -136,7 +162,8 @@ def new_round_aggregation(recipient, rkey, clerks, tag: str):
 
 
 def run_round(ix: int, stack, round_size: int, rate: float | None,
-              submit_services=None, kill_router=None, trace_ctx=None) -> dict:
+              submit_services=None, kill_router=None, trace_ctx=None,
+              workload: str = "dense") -> dict:
     """One full round; returns the per-round record. Raises on an
     inexact reveal — a soak that silently aggregates wrong numbers is
     worse than one that stops.
@@ -165,15 +192,17 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
     from sda_tpu import telemetry
 
     recipient, rkey, clerks, participant = stack
-    values = [[(ix + i) % 11, i % 7, 1, (3 * i) % 5] for i in range(round_size)]
-    expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
+    values = workload_values(ix, round_size, workload)
+    dim = len(values[0])
+    expected = [sum(v[d] for v in values) % MODULUS for d in range(dim)]
 
     t_round0 = time.perf_counter()
     victim = None
     churned = None
     try:
         with telemetry.trace(f"soak-round-{ix}") as trace_id:
-            agg = new_round_aggregation(recipient, rkey, clerks, str(ix))
+            agg = new_round_aggregation(recipient, rkey, clerks, str(ix),
+                                        dim=dim)
             if kill_router is not None:
                 victim = kill_router.targets(agg.id)[0]
                 kill_router.wedge(victim)
@@ -244,7 +273,7 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
         raise AssertionError(
             f"round {ix} inexact: got {list(out)}, want {expected}"
         )
-    return {
+    r = {
         "round": ix,
         "trace_id": trace_id,
         "n": round_size,
@@ -255,10 +284,35 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
         "killed_shard": victim,
         "churned": churned,
     }
+    if workload == "sketch":
+        # the exact grid must also DECODE: count-min never undercounts
+        # (guaranteed, so asserted); the one-sided overshoot vs the
+        # analytic bound is recorded per round
+        from collections import Counter
+
+        cm = _workload_sketch()
+        grid = np.asarray(out, dtype=np.int64)
+        true = Counter(
+            it for i in range(round_size) for it in workload_items(ix, i)
+        )
+        hot, hot_true = true.most_common(1)[0]
+        est = int(cm.point_query(grid, hot))
+        bound = cm.error_bound(grid)
+        if est < hot_true:
+            raise AssertionError(f"round {ix}: count-min undercounted {hot}")
+        r["sketch"] = {
+            "hot_item": hot,
+            "true": hot_true,
+            "estimate": est,
+            "bound": round(bound, 2),
+            "within_bound": bool(est <= hot_true + bound),
+        }
+    return r
 
 
 def measure_sampler_overhead(stack, round_size: int, ab_rounds: int,
-                             interval_s: float) -> dict | None:
+                             interval_s: float,
+                             workload: str = "dense") -> dict | None:
     """Sampler-off vs sampler-on A/B (PR-2 telemetry-A/B shape): one warm
     full round to populate the registry with every hot series (so the
     on-arm scrapes a realistic snapshot), then ``ab_rounds`` interleaved
@@ -272,7 +326,7 @@ def measure_sampler_overhead(stack, round_size: int, ab_rounds: int,
         return None
     # warm everything (JIT, connection pool, key caches) and light every
     # series the soak will light, so the scrape under test is full-size
-    run_round(9000, stack, round_size, None)
+    run_round(9000, stack, round_size, None, workload=workload)
     service = stack[3].service
     service.ping()
     batch = 200
@@ -390,6 +444,11 @@ def main() -> int:
                          "retry at the end of their round")
     ap.add_argument("--round-size", type=int, default=80,
                     help="participations per round (default 80)")
+    ap.add_argument("--workload", choices=["dense", "sketch"], default="dense",
+                    help="round payload: the dense 4-wide control vectors, "
+                         "or each phone's count-min sketch columns "
+                         "(dim 128) decoded after every exact reveal "
+                         "(default dense)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="sampler interval in seconds (default 2)")
     ap.add_argument("--ab-rounds", type=int, default=3,
@@ -468,6 +527,7 @@ def main() -> int:
             "duration_s": args.duration,
             "rate": args.rate,
             "round_size": args.round_size,
+            "workload": args.workload,
             "interval_s": args.interval,
             "frontends": args.frontends,
             "max_inflight": args.max_inflight,
@@ -509,7 +569,8 @@ def main() -> int:
             ]
 
         record["sampler_ab"] = measure_sampler_overhead(
-            stack, args.round_size, args.ab_rounds, args.interval
+            stack, args.round_size, args.ab_rounds, args.interval,
+            workload=args.workload,
         )
         if record["sampler_ab"]:
             record["sampler_overhead_pct"] = record["sampler_ab"]["overhead_pct"]
@@ -564,6 +625,7 @@ def main() -> int:
                     ix, stack, args.round_size, args.rate, submit_services,
                     kill_router=router if kill else None,
                     trace_ctx=trace_ctx,
+                    workload=args.workload,
                 ))
                 if grow_thread is not None:
                     grow_thread.join(timeout=90.0)

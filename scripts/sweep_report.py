@@ -60,6 +60,13 @@ cohort headline and its implied scale factor against the simulated
 population, rungs certified vs attempted, the peak certified
 phones-per-second, and the merged cross-process telemetry coverage.
 
+Also tabulates the sketch-accuracy rider artifacts
+(``bench-artifacts/sketch-<stamp>.json``, written by bench.py's
+measure_sketch_accuracy): the accuracy-vs-dimension table — one row per
+sketch family per wire dimension with the observed error, the analytic
+bound, the headroom ratio (bound / observed error, >= 1 inside bound),
+the end-to-end items/s, and whether the secure sum stayed byte-exact.
+
 Also rolls the churn harness's banked cells (``scenario-<name>-*.json``,
 written by scripts/scenarios.py) into the survivability matrix: scenario
 rows x (store, transport) columns, latest artifact per cell, OK / FAIL /
@@ -590,6 +597,72 @@ def print_flagship(rows) -> None:
         )
 
 
+def load_sketch(artdir: pathlib.Path):
+    """One row per sketch family per wire dimension per sketch-*.json
+    artifact (bench.py's measure_sketch_accuracy): the accuracy-vs-
+    dimension trend — observed error vs analytic bound, headroom, and
+    the end-to-end secure-round throughput."""
+    rows = []
+    for f in sorted(artdir.glob("sketch-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        fams = d.get("families") if isinstance(d, dict) else None
+        if not isinstance(fams, dict):
+            continue
+        for fam, body in sorted(fams.items()):
+            legs = body.get("legs") if isinstance(body, dict) else None
+            if not isinstance(legs, dict):
+                continue
+            # ascending wire dimension, so each family reads as a trend
+            for tag, leg in sorted(
+                legs.items(), key=lambda kv: (kv[1] or {}).get("dim") or 0
+            ):
+                if not isinstance(leg, dict) or leg.get("dim") is None:
+                    continue
+                rows.append(
+                    {
+                        "artifact": f.name,
+                        "family": fam,
+                        "tag": tag,
+                        "dim": leg.get("dim"),
+                        # countmin legs carry max_err, cardinality abs_err
+                        "err": (
+                            leg.get("max_err")
+                            if leg.get("max_err") is not None
+                            else leg.get("abs_err")
+                        ),
+                        "bound": leg.get("bound"),
+                        "headroom": leg.get("bound_headroom"),
+                        "within": leg.get("within_bound"),
+                        "items_per_s": leg.get("items_per_s"),
+                        "exact": leg.get("byte_exact"),
+                    }
+                )
+    return rows
+
+
+def print_sketch(rows) -> None:
+    print("\nsketch-accuracy riders (sketch-*.json):")
+    print(
+        f"{'family':>12} {'leg':>6} {'dim':>6} {'err':>8} {'bound':>8} "
+        f"{'headroom':>8} {'in_bnd':>6} {'items/s':>8} {'exact':>5}  artifact"
+    )
+    for r in rows:
+        within = "-" if r["within"] is None else ("yes" if r["within"] else "NO")
+        exact = "-" if r["exact"] is None else ("yes" if r["exact"] else "NO")
+        print(
+            f"{r['family']:>12} {r['tag']:>6} {r['dim']:>6} "
+            f"{r['err'] if r['err'] is not None else '-':>8} "
+            f"{r['bound'] if r['bound'] is not None else '-':>8} "
+            f"{r['headroom'] if r['headroom'] is not None else '-':>8} "
+            f"{within:>6} "
+            f"{r['items_per_s'] if r['items_per_s'] is not None else '-':>8} "
+            f"{exact:>5}  {r['artifact']}"
+        )
+
+
 def load_scenarios(artdir: pathlib.Path):
     """Latest record per (scenario, store, transport) cell from the churn
     harness's scenario-*.json artifacts (scripts/scenarios.py), plus any
@@ -686,6 +759,7 @@ def main() -> int:
     tier_rows = load_tier(artdir)
     soak_rows = load_soak(artdir)
     flagship_rows = load_flagship(artdir)
+    sketch_rows = load_sketch(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
         not rows
@@ -697,13 +771,14 @@ def main() -> int:
         and not tier_rows
         and not soak_rows
         and not flagship_rows
+        and not sketch_rows
         and not scenario_cells
     ):
         print(
             f"no rate-bearing exp-*.json, ingest-*.json, clerking-*.json, "
             f"reveal-*.json, committee-*.json, wire-*.json, tier-*.json, "
-            f"soak-*.json, flagship-*.json, or scenario-*.json artifacts "
-            f"under {artdir}/",
+            f"soak-*.json, flagship-*.json, sketch-*.json, or "
+            f"scenario-*.json artifacts under {artdir}/",
             file=sys.stderr,
         )
         return 1
@@ -754,6 +829,8 @@ def main() -> int:
         print_soak(soak_rows)
     if flagship_rows:
         print_flagship(flagship_rows)
+    if sketch_rows:
+        print_sketch(sketch_rows)
     if scenario_cells:
         print_scenarios(scenario_cells, overhead_rows)
     return 0

@@ -45,6 +45,7 @@ from .federated import (
     unflatten_pytree,
 )
 from .statistics import (
+    canonical_item_bytes,
     SecureCountDistinct,
     SecureCovariance,
     SecureFrequency,
@@ -91,6 +92,7 @@ __all__ = [
     "SecureQuantiles",
     "SecureStatistics",
     "quantiles_from_histogram",
+    "canonical_item_bytes",
     "dequantize_mean",
     "flatten_pytree",
     "quantize_update",

@@ -26,6 +26,38 @@ import numpy as np
 from .federated import FederatedAveraging, QuantizationSpec
 
 
+def canonical_item_bytes(item) -> bytes:
+    """Type-tagged canonical encoding of one hashable item.
+
+    Shared by every workload that hashes participant items
+    (``SecureCountDistinct`` here, the whole ``sda_tpu.sketches`` plane):
+    a cross-participant sum of hashed structures is only correct when
+    equal logical items hash identically on *every* participant — and
+    ``repr`` is not that (numpy scalar reprs differ across numpy
+    versions, e.g. ``np.int64(3)`` vs ``3``). Accepted types: str,
+    bytes, int/bool, float and their numpy scalar equivalents; anything
+    else raises. Cross-type equality follows Python set semantics
+    (``{1, 1.0, True}`` is one element), so integral floats and bools
+    encode as their int.
+    """
+    if isinstance(item, bytes):
+        return b"b" + item
+    if isinstance(item, str):
+        return b"s" + item.encode("utf-8")
+    if isinstance(item, (bool, np.bool_, int, np.integer)):
+        return b"i" + str(int(item)).encode("ascii")
+    if isinstance(item, (float, np.floating)):
+        f = float(item)
+        if f.is_integer():
+            return b"i" + str(int(f)).encode("ascii")
+        return b"f" + repr(f).encode("ascii")
+    raise TypeError(
+        f"hashed items must be str, bytes, int, or float "
+        f"(got {type(item).__name__}); hash-stable canonical encoding "
+        "is required for the cross-participant union"
+    )
+
+
 def _validate_vector(values, dim: int, clip: float) -> np.ndarray:
     """Shared submission check: shape ``(dim,)``, |coordinate| ≤ clip."""
     values = np.asarray(values, dtype=np.float64)
@@ -463,33 +495,9 @@ class SecureCountDistinct(SecureHistogram):
         self.fed = FederatedAveraging(self.spec, {"counts": np.zeros(m)})
         self.salt = salt
 
-    @staticmethod
-    def _canonical_bytes(item) -> bytes:
-        """Type-tagged canonical encoding of one item.
-
-        The union estimate is only correct when equal logical items hash
-        identically on *every* participant — ``repr`` is not that (numpy
-        scalar reprs differ across numpy versions, e.g. ``np.int64(3)``
-        vs ``3``). Accepted types: str, bytes, int/bool, float and their
-        numpy scalar equivalents; anything else raises. Cross-type
-        equality follows Python set semantics (``{1, 1.0, True}`` is one
-        element), so integral floats and bools encode as their int."""
-        if isinstance(item, bytes):
-            return b"b" + item
-        if isinstance(item, str):
-            return b"s" + item.encode("utf-8")
-        if isinstance(item, (bool, np.bool_, int, np.integer)):
-            return b"i" + str(int(item)).encode("ascii")
-        if isinstance(item, (float, np.floating)):
-            f = float(item)
-            if f.is_integer():
-                return b"i" + str(int(f)).encode("ascii")
-            return b"f" + repr(f).encode("ascii")
-        raise TypeError(
-            f"count-distinct items must be str, bytes, int, or float "
-            f"(got {type(item).__name__}); hash-stable canonical encoding "
-            "is required for the cross-participant union"
-        )
+    # the shared canonical encoding, kept as a staticmethod for callers
+    # that reached it through the class
+    _canonical_bytes = staticmethod(canonical_item_bytes)
 
     def _bin_of(self, item) -> int:
         import hashlib

@@ -1,0 +1,203 @@
+"""The linear-sketch workload plane: encode locally, sum securely,
+decode globally.
+
+Every sketch in this package is *linear*: the sketch of a union of
+datasets is the coordinate-wise sum of the per-dataset sketches. That
+makes secure aggregation the perfect merge operator — each participant
+encodes its private values into an integer vector, the existing
+pipeline (mask, share, seal, clerk, reveal) sums the vectors, and the
+recipient decodes ONLY the cohort-level sketch. Nothing about any
+individual's values leaves the device beyond its masked shares, and
+every sketch inherits packed-Shamir committees, tiers, shards,
+replicas, and dropout tolerance for free.
+
+Two contracts hold the plane together:
+
+- **Determinism.** ``encode`` is a pure function of ``(seed, row,
+  item)``: hashing is BLAKE2b over a type-tagged canonical byte
+  encoding of the item (``canonical_item_bytes``) with the seed, row
+  index, and a per-use domain tag mixed into the *message* (never the
+  16-byte-truncating ``salt=`` parameter). Equal logical items hash
+  identically on every participant and every platform — without this
+  the summed sketch is garbage.
+- **Exact integer sums.** ``SketchQuery`` rides ``FederatedAveraging``
+  with ``frac_bits=0`` and a field fitted to
+  ``n_participants x cell_bound``, the same discipline as
+  ``SecureHistogram``: the revealed field sum decodes to the exact
+  integer sum of the local sketches (byte-identical to a central numpy
+  sum), so the only error anywhere is the sketch's own analytic bound.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..models.federated import FederatedAveraging, QuantizationSpec
+from ..models.statistics import canonical_item_bytes
+
+
+def sketch_hash(seed: int, row: int, item, tag: bytes = b"") -> int:
+    """64-bit hash of one item, pure in ``(seed, row, item, tag)``.
+
+    ``tag`` separates hash uses that share a seed and row (e.g. the
+    count-sketch bucket hash vs its sign hash); seed and row are fixed-
+    width so no (seed, row) pair can collide with another by byte
+    concatenation.
+    """
+    h = hashlib.blake2b(
+        tag
+        + b"\x00"
+        + int(seed).to_bytes(8, "big", signed=False)
+        + int(row).to_bytes(4, "big", signed=False)
+        + canonical_item_bytes(item),
+        digest_size=8,
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
+class LinearSketch:
+    """Interface every sketch family implements.
+
+    Subclasses define:
+
+    - ``kind``: short family name (``"countmin"``, ...) — becomes the
+      ``workload`` telemetry label and the artifact/report key.
+    - ``dim``: the wire vector length.
+    - ``encode(values) -> (dim,) int64``: this participant's local
+      sketch. Pure in ``(seed, values)``; linear under concatenation of
+      value lists (encode(a) + encode(b) == encode(a ++ b) for counting
+      sketches — cardinality's bitmap is the documented exception, it
+      is linear in *touch counts* and decoded via the zero set).
+    - ``decode(summed, n) -> dict``: family-specific estimates off the
+      summed sketch of ``n`` participants. Always includes an explicit
+      analytic error bound next to every estimate.
+    - ``cell_bound(max_values) -> int``: the largest magnitude one
+      participant holding ``max_values`` values can put into a single
+      coordinate — ``SketchQuery`` fits the field to
+      ``n_participants x cell_bound`` so the secure sum can never wrap.
+    """
+
+    kind: str = "sketch"
+    dim: int = 0
+
+    def encode(self, values) -> np.ndarray:
+        raise NotImplementedError
+
+    def decode(self, summed, n: int) -> dict:
+        raise NotImplementedError
+
+    def cell_bound(self, max_values: int) -> int:
+        """Default: all of one participant's values can land in one
+        cell (true for every counting sketch in this package)."""
+        return int(max_values)
+
+    def _check_summed(self, summed) -> np.ndarray:
+        summed = np.asarray(summed, dtype=np.int64).reshape(-1)
+        if summed.shape != (self.dim,):
+            raise ValueError(
+                f"summed sketch has shape {summed.shape}, expected ({self.dim},)"
+            )
+        return summed
+
+
+class SketchQuery:
+    """One secure round of any ``LinearSketch`` over any ``SdaService``.
+
+    The round shape is ``SecureHistogram``'s: open / submit / close /
+    finish, with ``frac_bits=0`` so the revealed sum is the exact
+    integer sum of the local sketches. ``finish`` returns the summed
+    sketch (centered int64 — count-sketch cells are signed) and ticks
+    ``sda_workload_rounds_total{workload=<kind>}``; ``finish_decoded``
+    also runs the sketch's decode.
+
+    ``max_values_per_participant`` bounds one participant's value count
+    and, via ``sketch.cell_bound``, sizes the field; ``submit`` rejects
+    encodes that exceed the fitted cell bound rather than wrapping the
+    cohort sum.
+    """
+
+    def __init__(
+        self,
+        sketch: LinearSketch,
+        n_participants: int,
+        max_values_per_participant: int = 1 << 20,
+        **shamir_kw,
+    ):
+        if sketch.dim < 1:
+            raise ValueError("sketch dimension must be >= 1")
+        self.sketch = sketch
+        self.max_values = int(max_values_per_participant)
+        self._cell_bound = int(sketch.cell_bound(self.max_values))
+        self.spec, self.sharing = QuantizationSpec.fitted(
+            0, float(self._cell_bound), n_participants, **shamir_kw
+        )
+        self.fed = FederatedAveraging(
+            self.spec, {"sketch": np.zeros(sketch.dim)}
+        )
+
+    def open_round(self, recipient, recipient_key, sharing=None, *, title=None):
+        """Recipient: open the aggregation. ``sharing`` defaults to the
+        fitted packed-Shamir scheme; any scheme over the same field
+        (e.g. ``AdditiveSharing(modulus=query.spec.modulus)``) works."""
+        return self.fed.open_round(
+            recipient,
+            recipient_key,
+            self.sharing if sharing is None else sharing,
+            title=title or f"sketch-{self.sketch.kind}",
+        )
+
+    def local_sketch(self, values) -> np.ndarray:
+        """Validate + encode one participant's values (shared with the
+        submit path so tests and drivers sum exactly what is sent)."""
+        values = list(values)
+        if len(values) > self.max_values:
+            raise ValueError(f"more than {self.max_values} values")
+        enc = self.sketch.encode(values)
+        enc = np.asarray(enc, dtype=np.int64).reshape(-1)
+        if enc.shape != (self.sketch.dim,):
+            raise ValueError(
+                f"encode returned shape {enc.shape}, expected ({self.sketch.dim},)"
+            )
+        if enc.size and int(np.abs(enc).max()) > self._cell_bound:
+            raise ValueError(
+                f"encoded cell magnitude {int(np.abs(enc).max())} exceeds the "
+                f"fitted bound {self._cell_bound}"
+            )
+        return enc
+
+    def submit(self, participant, aggregation_id, values) -> None:
+        self.fed.submit_update(
+            participant,
+            aggregation_id,
+            {"sketch": self.local_sketch(values).astype(np.float64)},
+        )
+
+    def close_round(self, recipient, aggregation_id) -> None:
+        self.fed.close_round(recipient, aggregation_id)
+
+    def finish(self, recipient, aggregation_id, n_submitted: int) -> np.ndarray:
+        """-> (dim,) int64 exact summed sketch.
+
+        Centered lift off the raw field sum: frac_bits=0 and the fitted
+        field guarantee |sum| < p/2, so the lifted residues ARE the
+        integer sums (count-sketch's signed cells included)."""
+        from .. import telemetry
+
+        raw = self.fed.reveal_field_sum(recipient, aggregation_id, n_submitted)
+        summed = np.rint(self.spec.dequantize_sum(raw)).astype(np.int64)
+        if telemetry.enabled():
+            telemetry.counter(
+                "sda_workload_rounds_total",
+                "completed secure workload rounds by workload family",
+                workload=self.sketch.kind,
+            ).inc()
+        return summed
+
+    def finish_decoded(self, recipient, aggregation_id, n_submitted: int) -> dict:
+        """-> {"summed": (dim,) int64, **sketch.decode(summed, n)}."""
+        summed = self.finish(recipient, aggregation_id, n_submitted)
+        out = {"summed": summed}
+        out.update(self.sketch.decode(summed, n_submitted))
+        return out

@@ -156,6 +156,46 @@ def test_flagship_certified_cohort_drop_fails(tmp_path, capsys):
     assert "certified_max_cohort" in out and "peak_cohort_per_s" in out
 
 
+def test_sketch_headroom_drop_fails(tmp_path, capsys):
+    """sketch-* gates accuracy, not just throughput: data and seeds are
+    pinned, so a bound_headroom collapse means the estimator changed —
+    even when items/s held steady."""
+    legs_hi = {"w64": {"dim": 256, "items_per_s": 3200,
+                       "bound_headroom": 3.6},
+               "w256": {"dim": 1024, "items_per_s": 5200,
+                        "bound_headroom": 3.2}}
+    legs_lo = {"w64": {"dim": 256, "items_per_s": 3300,
+                       "bound_headroom": 1.1},  # -69%: estimator broke
+                "w256": {"dim": 1024, "items_per_s": 5100,
+                         "bound_headroom": 3.1}}
+    _write(tmp_path, "sketch-20260801-010000.json",
+           {"metric": "sketch_accuracy",
+            "families": {"countmin": {"legs": legs_hi}}})
+    _write(tmp_path, "sketch-20260805-010000.json",
+           {"metric": "sketch_accuracy",
+            "families": {"countmin": {"legs": legs_lo}}})
+    assert _run(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "countmin_w64_bound_headroom" in out
+    assert out.count("REGRESSED") == 1  # throughput held; only accuracy trips
+
+
+def test_sketch_compares_best_items_per_s_per_family(tmp_path):
+    """Per-family throughput is the envelope across wire dimensions, so
+    a new run that merely reshuffles which dimension is fastest passes."""
+    _write(tmp_path, "sketch-20260801-010000.json",
+           {"families": {"cardinality": {"legs": {
+               "m256": {"dim": 256, "items_per_s": 3000, "bound_headroom": 1.6},
+               "m1024": {"dim": 1024, "items_per_s": 3500,
+                         "bound_headroom": 2.7}}}}})
+    _write(tmp_path, "sketch-20260805-010000.json",
+           {"families": {"cardinality": {"legs": {
+               "m256": {"dim": 256, "items_per_s": 3400, "bound_headroom": 1.6},
+               "m1024": {"dim": 1024, "items_per_s": 3100,
+                         "bound_headroom": 2.7}}}}})  # envelope 3500->3400: noise
+    assert _run(tmp_path) == 0
+
+
 def test_grow_soak_family_is_separate_from_soak(tmp_path, capsys):
     """grow-soak-* must compare against other grow-soak runs, never
     against plain soak-* (a grow pass is slower by construction)."""

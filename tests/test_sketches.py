@@ -1,0 +1,437 @@
+"""The sketch plane (sda_tpu/sketches): analytic bounds at fixed seeds,
+and byte-exact secure sums across the scheme x store x transport matrix.
+
+Two layers of contract:
+
+1. **Sketch math** (no service): every family's decode lands inside its
+   stated analytic error bound at fixed seeds, encodes are linear under
+   dataset concatenation, and hashing is a pure function of
+   ``(seed, row, item)`` with canonical cross-type item encoding.
+2. **Secure aggregation** (full protocol): the securely-summed sketch
+   is BYTE-IDENTICAL to the centrally-computed numpy sum of the local
+   sketches for every cell of {additive, packed Shamir} x {mem, sqlite}
+   x {in-proc, REST} — the matrix is explicit here (not env-switched)
+   so one tier-1 run covers every cell — plus a tiered==flat
+   equivalence round for the count-min payload (the PR-14 matrix
+   shape: same values, 2-tier m=2 tree vs flat, identical bytes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+import sys
+from collections import Counter
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from sda_fixtures import new_client, with_service
+from sda_tpu import telemetry
+from sda_tpu.client import run_committee, run_tier_round, setup_tier_round
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    AgentId,
+    ChaChaMasking,
+    EncryptionKeyId,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.sketches import (
+    CountMinSketch,
+    CountSketch,
+    DyadicQuantiles,
+    LinearCountingSketch,
+    SketchQuery,
+    TopKSketch,
+    sketch_hash,
+)
+
+# -- sketch math: bounds, linearity, determinism -----------------------------
+
+SEED = 20260806
+
+
+def _skewed_items(n=400, hot=(3, 17, 41), hot_share=40, domain=64, seed=SEED):
+    """A categorical stream with planted heavy hitters: each hot item
+    appears ``hot_share`` times, the rest spread over the domain."""
+    rng = np.random.default_rng(seed)
+    items = [int(h) for h in hot for _ in range(hot_share)]
+    items += [int(v) for v in rng.integers(0, domain, size=n - len(items))]
+    rng.shuffle(items)
+    return items
+
+
+def test_sketch_hash_pure_and_separated():
+    assert sketch_hash(1, 2, "x") == sketch_hash(1, 2, "x")
+    assert sketch_hash(1, 2, "x") != sketch_hash(1, 3, "x")
+    assert sketch_hash(2, 2, "x") != sketch_hash(1, 2, "x")
+    assert sketch_hash(1, 2, "x", tag=b"a") != sketch_hash(1, 2, "x", tag=b"b")
+    # canonical cross-type encoding: {1, 1.0, True} is one logical item
+    assert sketch_hash(1, 2, 1) == sketch_hash(1, 2, 1.0) == sketch_hash(1, 2, True)
+    assert sketch_hash(1, 2, np.int64(7)) == sketch_hash(1, 2, 7)
+
+
+def test_countmin_linearity_and_point_query_bound():
+    cm = CountMinSketch(width=64, depth=4, seed=SEED)
+    items = _skewed_items()
+    parts = [items[i::5] for i in range(5)]
+    summed = sum(cm.encode(p) for p in parts)
+    np.testing.assert_array_equal(summed, cm.encode(items))  # linear
+    true = Counter(items)
+    dec = cm.decode(summed, 5)
+    assert dec["total"] == len(items)
+    bound = dec["error_bound"]
+    assert bound == pytest.approx(cm.epsilon * len(items))
+    for x in range(64):  # one-sided: never under, over by <= eps*N
+        est = cm.point_query(summed, x)
+        assert true[x] <= est <= true[x] + bound
+    # planted heavy hitters all clear a threshold below their true count
+    hits = cm.heavy_hitters(summed, range(64), threshold=30)
+    assert {3, 17, 41} <= {i for i, _ in hits}
+
+
+def test_countsketch_signed_and_median_bound():
+    cs = CountSketch(width=64, depth=5, seed=SEED)
+    items = _skewed_items()
+    parts = [items[i::5] for i in range(5)]
+    summed = sum(cs.encode(p) for p in parts)
+    np.testing.assert_array_equal(summed, cs.encode(items))
+    assert summed.min() < 0, "signed cells are the point of count-sketch"
+    true = Counter(items)
+    bound = cs.error_bound(summed)
+    for x in range(64):  # two-sided L2 bound at this seed
+        assert abs(cs.point_query(summed, x) - true[x]) <= bound
+    dec = cs.decode(summed, 5)
+    assert dec["f2_estimate"] > 0 and dec["error_bound"] == pytest.approx(bound)
+
+
+def test_dyadic_quantiles_rank_bound():
+    dq = DyadicQuantiles(universe_bits=8, width=128, depth=4, seed=SEED)
+    rng = np.random.default_rng(SEED)
+    vals = sorted(int(v) for v in rng.integers(0, 256, size=600))
+    parts = [vals[i::6] for i in range(6)]
+    summed = sum(dq.encode(p) for p in parts)
+    np.testing.assert_array_equal(summed, dq.encode(vals))
+    assert dq.total(summed) == len(vals)
+    bound = dq.rank_error_bound(summed)
+    import bisect
+
+    for x in (0, 1, 50, 128, 255, 256):  # one-sided rank estimates
+        true_rank = bisect.bisect_left(vals, x)
+        assert true_rank <= dq.rank(summed, x) <= true_rank + bound
+    for q in (0.1, 0.25, 0.5, 0.75, 0.9):
+        est = dq.quantile_query(summed, q)
+        target = max(1, int(np.ceil(q * len(vals))))
+        # the returned value's true rank interval contains the target
+        # to within the analytic rank error
+        assert bisect.bisect_left(vals, est) - bound <= target
+        assert bisect.bisect_right(vals, est) + bound >= target
+    dec = dq.decode(summed, 6)
+    assert dec["quantiles"][0.5] == dq.quantile_query(summed, 0.5)
+
+
+def test_cardinality_estimate_within_bound():
+    lc = LinearCountingSketch(m=512, seed=SEED)
+    distinct = [f"item-{i}" for i in range(180)]
+    # overlapping per-participant slices: the union is what's estimated
+    parts = [distinct[i::4] + distinct[:25] for i in range(4)]
+    summed = sum(lc.encode(p) for p in parts)
+    dec = lc.decode(summed, 4)
+    assert abs(dec["estimate"] - len(distinct)) <= dec["error_bound"]
+    assert dec["error_bound"] == pytest.approx(3.0 * dec["std_error"])
+
+
+def test_cardinality_saturation_raises():
+    lc = LinearCountingSketch(m=8, seed=SEED)
+    summed = lc.encode([f"x{i}" for i in range(500)])
+    assert int((summed == 0).sum()) == 0
+    with pytest.raises(ValueError, match="saturated"):
+        lc.decode(summed, 1)
+
+
+def test_topk_recovers_planted_heavy_hitters():
+    tk = TopKSketch(k=3, candidates=list(range(64)), width=256, depth=4, seed=SEED)
+    items = _skewed_items(hot_share=60)
+    parts = [items[i::5] for i in range(5)]
+    summed = sum(tk.encode(p) for p in parts)
+    dec = tk.decode(summed, 5)
+    # the hot items beat the tail by far more than 2*eps*N at width=256
+    assert {i for i, _ in dec["topk"]} == {3, 17, 41}
+    true = Counter(items)
+    for item, est in dec["topk"]:  # count-min never undercounts
+        assert true[item] <= est <= true[item] + dec["error_bound"]
+
+
+def test_sketch_validation_errors():
+    with pytest.raises(ValueError):
+        CountMinSketch(width=0, depth=2)
+    with pytest.raises(ValueError):
+        CountSketch(width=4, depth=0)
+    with pytest.raises(ValueError):
+        DyadicQuantiles(universe_bits=0, width=4, depth=2)
+    dq = DyadicQuantiles(universe_bits=4, width=8, depth=2)
+    with pytest.raises(ValueError, match=r"\[0, 16\)"):
+        dq.encode([16])
+    with pytest.raises(ValueError, match=r"\[0, 16\]"):
+        dq.rank(dq.encode([1]), 17)
+    with pytest.raises(ValueError, match="candidate count"):
+        TopKSketch(k=5, candidates=[1, 2], width=8, depth=2)
+    q = SketchQuery(CountMinSketch(8, 2), n_participants=4,
+                    max_values_per_participant=4)
+    with pytest.raises(ValueError, match="more than 4"):
+        q.local_sketch([1, 2, 3, 4, 5])
+
+
+# -- secure rounds: the scheme x store x transport matrix --------------------
+
+
+@contextlib.contextmanager
+def _service_cell(store: str, transport: str, tmp_path):
+    """One explicit cell of the store x transport matrix (unlike
+    ``with_service`` this does not read the env — the point is that a
+    single tier-1 run covers every cell)."""
+    if store == "sqlite":
+        from sda_tpu.server import new_sqlite_server
+
+        server = new_sqlite_server(str(tmp_path / "sda.db"))
+    else:
+        from sda_tpu.server import new_mem_server
+
+        server = new_mem_server()
+    if transport == "inproc":
+        yield server
+        return
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+
+    with serve_background(server) as base_url:
+        yield SdaHttpClient(base_url, TokenStore(str(tmp_path / "tokens")))
+
+
+def _run_secure_round(tmp_path, service, query, sharing, datasets):
+    recipient = new_client(tmp_path / "r", service)
+    recipient.upload_agent()
+    rkey = recipient.new_encryption_key()
+    recipient.upload_encryption_key(rkey)
+    clerks = [
+        new_client(tmp_path / f"c{i}", service)
+        for i in range(sharing.output_size)
+    ]
+    for c in clerks:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    agg_id = query.open_round(recipient, rkey, sharing)
+    for i, values in enumerate(datasets):
+        part = new_client(tmp_path / f"p{i}", service)
+        part.upload_agent()
+        query.submit(part, agg_id, values)
+    query.close_round(recipient, agg_id)
+    for w in [recipient] + clerks:
+        w.run_chores(-1)
+    return query.finish(recipient, agg_id, len(datasets))
+
+
+def _sharing_for(scheme: str, query: SketchQuery):
+    if scheme == "packed":
+        return query.sharing  # the fitted packed-Shamir scheme
+    return AdditiveSharing(share_count=3, modulus=query.spec.modulus)
+
+
+@pytest.mark.parametrize("transport", ["inproc", "rest"])
+@pytest.mark.parametrize("store", ["mem", "sqlite"])
+@pytest.mark.parametrize("scheme", ["additive", "packed"])
+def test_secure_sum_byte_exact_matrix(scheme, store, transport, tmp_path):
+    """The acceptance matrix: securely-aggregated count-min == central
+    numpy sum of the local sketches, byte for byte, in every cell."""
+    cm = CountMinSketch(width=16, depth=2, seed=SEED)
+    query = SketchQuery(cm, n_participants=8, max_values_per_participant=64)
+    datasets = [
+        _skewed_items(n=40, hot_share=5, domain=32, seed=SEED + i)
+        for i in range(3)
+    ]
+    expected = sum(query.local_sketch(d) for d in datasets)
+    with _service_cell(store, transport, tmp_path) as service:
+        summed = _run_secure_round(
+            tmp_path, service, query, _sharing_for(scheme, query), datasets
+        )
+    assert summed.dtype == np.int64
+    assert summed.tobytes() == expected.tobytes()
+
+
+@pytest.mark.parametrize("scheme", ["additive", "packed"])
+def test_secure_countsketch_signed_byte_exact(scheme, tmp_path):
+    """Signed cells survive the centered field lift exactly."""
+    cs = CountSketch(width=16, depth=3, seed=SEED)
+    query = SketchQuery(cs, n_participants=8, max_values_per_participant=64)
+    datasets = [[f"w{i}-{j}" for j in range(20)] + ["hot"] * 10 for i in range(3)]
+    expected = sum(query.local_sketch(d) for d in datasets)
+    assert expected.min() < 0
+    with with_service() as ctx:
+        summed = _run_secure_round(
+            tmp_path, ctx.service, query, _sharing_for(scheme, query), datasets
+        )
+    assert summed.tobytes() == expected.tobytes()
+
+
+def test_secure_round_decodes_within_bounds(tmp_path):
+    """End-to-end accuracy: a secure top-k round recovers the planted
+    heavy hitters and every estimate honors the count-min bound."""
+    tk = TopKSketch(k=3, candidates=list(range(64)), width=256, depth=4, seed=SEED)
+    query = SketchQuery(tk, n_participants=8, max_values_per_participant=512)
+    items = _skewed_items(hot_share=60)
+    datasets = [items[i::4] for i in range(4)]
+    true = Counter(items)
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        clerks = [new_client(tmp_path / f"c{i}", ctx.service) for i in range(8)]
+        for c in clerks:
+            c.upload_agent()
+            c.upload_encryption_key(c.new_encryption_key())
+        agg_id = query.open_round(recipient, rkey)
+        for i, values in enumerate(datasets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            query.submit(part, agg_id, values)
+        query.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        dec = query.finish_decoded(recipient, agg_id, len(datasets))
+    assert dec["total"] == len(items)
+    assert {i for i, _ in dec["topk"]} == {3, 17, 41}
+    for item, est in dec["topk"]:
+        assert true[item] <= est <= true[item] + dec["error_bound"]
+
+
+def test_workload_rounds_counted(tmp_path):
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        cm = CountMinSketch(width=8, depth=2, seed=SEED)
+        query = SketchQuery(cm, n_participants=4, max_values_per_participant=16)
+        with with_service() as ctx:
+            _run_secure_round(
+                tmp_path, ctx.service, query,
+                _sharing_for("additive", query), [[1, 2, 3], [2, 3, 4]],
+            )
+        counters = telemetry.snapshot(include_spans=0)["counters"]
+        ticks = [
+            c for c in counters if c["name"] == "sda_workload_rounds_total"
+        ]
+        assert ticks and ticks[0]["labels"]["workload"] == "countmin"
+        assert sum(c["value"] for c in ticks) == 1
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(was)
+
+
+# -- tiered == flat for the count-min payload (the PR-14 shape) --------------
+
+TIER_MODULUS = 100003
+
+
+def _sketch_aggregation(dim, tiers=None, m=None) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title="sketch-tiers-test",
+        vector_dimension=dim,
+        modulus=TIER_MODULUS,
+        recipient=AgentId.random(),
+        recipient_key=EncryptionKeyId.random(),
+        masking_scheme=ChaChaMasking(
+            modulus=TIER_MODULUS, dimension=dim, seed_bitsize=128
+        ),
+        committee_sharing_scheme=AdditiveSharing(
+            share_count=3, modulus=TIER_MODULUS
+        ),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+        sub_cohort_size=m,
+        tiers=tiers,
+    )
+
+
+def _provision_pool(tmp_path, service, n):
+    pool = [new_client(tmp_path / f"clerk{i}", service) for i in range(n)]
+    for c in pool:
+        c.upload_agent()
+        c.upload_encryption_key(c.new_encryption_key())
+    return pool
+
+
+def test_tiered_countmin_payload_matches_flat_bytes(tmp_path):
+    """Fat sketch columns through the tier tree: a 2-tier m=2 round over
+    per-participant count-min encodes reveals byte-identically to the
+    flat round AND to the central numpy sum — then decodes within the
+    count-min bound. This is the flagship sketch payload in miniature."""
+    cm = CountMinSketch(width=16, depth=2, seed=SEED)
+    sketches = [
+        cm.encode(_skewed_items(n=30, hot_share=4, domain=32, seed=SEED + i))
+        for i in range(5)
+    ]
+    expected = np.asarray(sum(sketches), dtype=np.int64) % TIER_MODULUS
+    values = [[int(v) for v in s] for s in sketches]
+
+    with with_service() as ctx:
+        # flat control
+        recipient = new_client(tmp_path / "flat-r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+        agg = _sketch_aggregation(cm.dim)
+        agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+        recipient.upload_aggregation(agg)
+        pool = _provision_pool(tmp_path / "flat", ctx.service, 3)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in pool]
+        )
+        for i, v in enumerate(values):
+            p = new_client(tmp_path / f"flat-p{i}", ctx.service)
+            p.upload_agent()
+            p.participate(v, agg.id)
+        recipient.end_aggregation(agg.id)
+        run_committee(pool, -1)
+        flat = recipient.reveal_aggregation(agg.id).positive()
+        assert flat.values.astype(np.int64).tobytes() == expected.tobytes()
+
+        # tiered round over the same values
+        t_recipient = new_client(tmp_path / "tier-r", ctx.service)
+        t_recipient.upload_agent()
+        t_rkey = t_recipient.new_encryption_key()
+        t_recipient.upload_encryption_key(t_rkey)
+        t_agg = _sketch_aggregation(cm.dim, tiers=2, m=2)
+        t_agg.recipient, t_agg.recipient_key = t_recipient.agent.id, t_rkey
+        t_pool = _provision_pool(tmp_path / "tier", ctx.service, 3)
+        round = setup_tier_round(
+            t_recipient, t_agg,
+            lambda name: new_client(tmp_path / f"tier-{name}", ctx.service),
+            t_pool,
+        )
+        for i, v in enumerate(values):
+            p = new_client(tmp_path / f"tier-p{i}", ctx.service)
+            p.upload_agent()
+            p.participate(v, t_agg.id)
+        result = run_tier_round(round)
+        assert result.skipped == []
+        tiered = result.output.positive()
+        assert tiered.values.astype(np.int64).tobytes() == flat.values.astype(np.int64).tobytes()
+
+    # and the decoded payload still honors the analytic bound
+    all_items = [
+        x
+        for i in range(5)
+        for x in _skewed_items(n=30, hot_share=4, domain=32, seed=SEED + i)
+    ]
+    true = Counter(all_items)
+    summed = tiered.values.astype(np.int64)
+    bound = cm.error_bound(summed)
+    for x in range(32):
+        assert true[x] <= cm.point_query(summed, x) <= true[x] + bound

@@ -570,3 +570,71 @@ def test_count_distinct_canonical_hashing():
         cd._bin_of(object())
     with pytest.raises(TypeError, match="canonical"):
         cd._bin_of((1, 2))
+
+
+def test_histogram_clamping_through_secure_round(tmp_path):
+    """Negative path, full pipeline: out-of-range submissions (below lo,
+    above hi, int64-overflowing floats) must land in the EDGE bins of
+    the revealed cohort histogram with the total count preserved — the
+    clamp is part of the protocol contract, not just a local nicety."""
+    hist = SecureHistogram(bins=4, lo=0.0, hi=4.0, n_participants=4)
+    datasets = [
+        np.array([-7.0, -1e300, 0.5]),   # two below-range -> bin 0
+        np.array([9.0, 1e300, 3.5]),     # two above-range -> bin 3
+        np.array([1.5, 2.5]),            # in-range control
+    ]
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = hist.open_round(recipient, rkey)
+        for i, vals in enumerate(datasets):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            hist.submit(part, agg_id, vals)
+        hist.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        counts = hist.finish(recipient, agg_id, len(datasets))
+
+    np.testing.assert_array_equal(counts, [3, 1, 1, 3])
+    assert counts.sum() == sum(len(v) for v in datasets)
+
+
+def test_covariance_clip_rejection_creates_no_participation(tmp_path):
+    """Negative path: a submission exceeding the clip bound (or carrying
+    NaN/inf) is rejected BEFORE any participation reaches the service —
+    the round stays clean and finishes exactly over the valid cohort."""
+    from sda_tpu.models.statistics import SecureCovariance
+
+    cov = SecureCovariance(dim=2, clip=2.0, n_participants=4, frac_bits=12)
+    with pytest.raises(ValueError):
+        SecureCovariance(dim=0, clip=1.0, n_participants=2)
+    with with_service() as ctx:
+        recipient, rkey, clerks = _setup(ctx, tmp_path)
+        agg_id = cov.open_round(recipient, rkey)
+        bad = new_client(tmp_path / "bad", ctx.service)
+        bad.upload_agent()
+        with pytest.raises(ValueError, match="clip bound"):
+            cov.submit(bad, agg_id, np.array([0.0, 5.0]))
+        with pytest.raises(ValueError, match="expected"):
+            cov.submit(bad, agg_id, np.zeros(3))
+        with pytest.raises(ValueError):
+            cov.submit(bad, agg_id, np.array([np.nan, 0.0]))
+        status = ctx.service.get_aggregation_status(recipient.agent, agg_id)
+        assert status.number_of_participations == 0  # nothing leaked through
+        good = np.array([[1.0, -1.0], [2.0, 1.0], [-2.0, 0.5]])
+        for i, v in enumerate(good):
+            part = new_client(tmp_path / f"p{i}", ctx.service)
+            part.upload_agent()
+            cov.submit(part, agg_id, v)
+        cov.close_round(recipient, agg_id)
+        for w in [recipient] + clerks:
+            w.run_chores(-1)
+        result = cov.finish(recipient, agg_id, len(good))
+
+    tol = len(good) / cov.spec.scale * 30
+    np.testing.assert_allclose(result["mean"], good.mean(axis=0), atol=tol)
+    np.testing.assert_allclose(
+        result["covariance"],
+        np.cov(good.T, bias=True),
+        atol=tol,
+    )

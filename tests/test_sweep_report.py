@@ -375,6 +375,47 @@ def test_flagship_campaign_section(tmp_path, capsys):
     assert "grow-soak-20260806-010000.json" in out  # soak section variant
 
 
+def test_sketch_rider_section(tmp_path, capsys):
+    _write(tmp_path, "sketch-20260806-010000.json",
+           {"metric": "sketch_accuracy",
+            "config": {"n_phones": 4, "seed": 20260806},
+            "families": {
+                "countmin": {"legs": {
+                    # inserted out of dim order: the table must sort by
+                    # wire dimension so each family reads as a trend
+                    "w1024": {"dim": 4096, "width": 1024, "depth": 4,
+                              "items_per_s": 3999, "max_err": 0.0,
+                              "bound": 1.59, "within_bound": True,
+                              "bound_headroom": 1.593, "byte_exact": True},
+                    "w64": {"dim": 256, "width": 64, "depth": 4,
+                            "items_per_s": 3243, "max_err": 7.0,
+                            "bound": 25.48, "within_bound": True,
+                            "bound_headroom": 3.641, "byte_exact": True}}},
+                "cardinality": {"legs": {
+                    "m256": {"dim": 256, "items_per_s": 3545,
+                             "estimate": 220.9, "true": 200, "abs_err": 20.9,
+                             "bound": 34.2, "within_bound": True,
+                             "bound_headroom": 1.633, "byte_exact": True}}}}})
+    _write(tmp_path, "sketch-broken.json", {"note": "no families"})  # excluded
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        # sketch rows alone are evidence: exit 0 without any exp-*.json
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "sketch-accuracy riders" in out
+    assert "sketch-20260806-010000.json" in out
+    assert "sketch-broken.json" not in out
+    # countmin rows ascend by dim: w64 (256) before w1024 (4096)
+    cm = [ln for ln in out.splitlines() if ln.strip().startswith("countmin")]
+    assert [ln.split()[1] for ln in cm] == ["w64", "w1024"]
+    assert "3.641" in out   # headroom column
+    assert "20.9" in out    # cardinality rows surface abs_err as err
+    assert "25.48" in out   # countmin rows surface bound
+
+
 def test_empty_dir_is_an_error(tmp_path):
     old = sys.argv
     sys.argv = ["sweep_report.py", str(tmp_path)]
