@@ -107,7 +107,10 @@ def _metrics_shard(d: dict) -> dict:
 def _metrics_tier(d: dict) -> dict:
     """tier-*: clerked inputs per clerk-second, one metric per fan-out
     config (flat baseline included — a flat-path regression must not hide
-    behind the tiered columns)."""
+    behind the tiered columns), plus the promotion A/B leg as the
+    reveal-over-reshare per-node latency ratio — >1 means share-promotion
+    beats the reveal round-trip it replaced, and a drop means that edge
+    eroded."""
     out = {}
     configs = d.get("configs") if isinstance(d.get("configs"), dict) else {}
     for tag, cfg in configs.items():
@@ -115,6 +118,26 @@ def _metrics_tier(d: dict) -> dict:
             cfg.get("inputs_per_clerk_s"), (int, float)
         ):
             out[f"{tag}_inputs_per_clerk_s"] = float(cfg["inputs_per_clerk_s"])
+    ab = d.get("promotion_ab") if isinstance(d.get("promotion_ab"), dict) else {}
+    per_node = {
+        path: leg.get("per_node_promotion_s")
+        for path, leg in ab.items()
+        if isinstance(leg, dict)
+    }
+    # gate the within-run speedup (reveal latency / reshare latency), not
+    # the absolute per-path rates: absolute node latencies drift with
+    # host load run to run, while the two legs of one artifact were
+    # interleaved on the same host so their ratio is drift-invariant —
+    # it regresses exactly when share-promotion stops beating the reveal
+    # round-trip
+    if (
+        isinstance(per_node.get("reveal"), (int, float))
+        and isinstance(per_node.get("reshare"), (int, float))
+        and per_node["reshare"] > 0
+    ):
+        out["promote_reshare_speedup"] = round(
+            per_node["reveal"] / per_node["reshare"], 4
+        )
     return out
 
 
@@ -130,7 +153,13 @@ def _metrics_flagship(d: dict) -> dict:
     """flagship-*: the certified-cohort headline plus the fastest
     certified rung's phones-per-second. Both higher-is-better, so the
     generic delta logic applies: a ladder that stops certifying earlier,
-    or certifies the same rung slower, reads as a regression."""
+    or certifies the same rung slower, reads as a regression.
+
+    The rate metric is keyed by the campaign's tier promotion path
+    (``tier_path``; artifacts that predate the field ran the reveal
+    path) so a path switch — which also switches the committee scheme
+    and its per-job crypto cost — never pairs rates across schemes;
+    ``certified_max_cohort`` stays comparable across every campaign."""
     out = {}
     if isinstance(d.get("certified_max_cohort"), (int, float)) \
             and d["certified_max_cohort"] > 0:
@@ -143,7 +172,8 @@ def _metrics_flagship(d: dict) -> dict:
         and isinstance(r.get("round_s"), (int, float)) and r["round_s"] > 0
     ]
     if rates:
-        out["peak_cohort_per_s"] = float(max(rates))
+        path = d.get("tier_path") or "reveal"
+        out[f"{path}_peak_cohort_per_s"] = float(max(rates))
     return out
 
 

@@ -79,10 +79,15 @@ REQUIRED_SERIES = [
     # (SDA_TS defaults on) and must have banked at least one window by
     # scrape time — main() shrinks the interval and waits for the tick
     "sda_ts_samples_total",
-    # hierarchical plane: drive_tier_round runs one 2-tier round, so the
-    # promotion counter and the depth gauge must both show
+    # hierarchical plane: drive_tier_round runs one 2-tier round per
+    # promotion path (additive -> reveal, Shamir -> share-promotion), so
+    # the promotion counter (both path labels — asserted separately in
+    # main), the depth gauge, the clerk-side re-share histogram, and the
+    # driver-side promotion histogram must all show
     "sda_tier_promotions_total",
     "sda_tier_depth",
+    "sda_tier_reshare_seconds",
+    "sda_tier_promote_seconds",
     # workload plane: drive_sketch_round completes one count-min round
     # through SketchQuery, which ticks the per-family round counter
     "sda_workload_rounds_total",
@@ -168,17 +173,21 @@ def drive_workload(base_url: str, tmp: str) -> None:
 
 
 def drive_tier_round(base_url: str, tmp: str) -> None:
-    """One 2-tier hierarchical round (fan-out 2) over the live REST stack,
-    so the tier plane's series — sda_tier_promotions_total (server counts
-    sub-committee partials climbing into the root) and sda_tier_depth —
-    appear in the scrape, and the derived-tree provisioning + bottom-up
-    driver run against real HTTP at least once per CI pass."""
+    """Two 2-tier hierarchical rounds (fan-out 2) over the live REST
+    stack — one per promotion path — so the whole tier plane shows in the
+    scrape: sda_tier_promotions_total with BOTH path labels (additive
+    committees promote by reveal, Shamir committees by share-promotion),
+    sda_tier_depth, the clerk-side sda_tier_reshare_seconds histogram and
+    the driver-side sda_tier_promote_seconds histogram. The derived-tree
+    provisioning and both bottom-up drivers run against real HTTP once
+    per CI pass."""
     from sda_tpu.client import SdaClient, run_tier_round, setup_tier_round
     from sda_tpu.crypto import Keystore
     from sda_tpu.protocol import (
         AdditiveSharing,
         Aggregation,
         AggregationId,
+        BasicShamirSharing,
         ChaChaMasking,
         SodiumEncryptionScheme,
     )
@@ -189,41 +198,59 @@ def drive_tier_round(base_url: str, tmp: str) -> None:
         service = SdaHttpClient(base_url, TokenStore(os.path.join(tmp, subdir)))
         return SdaClient(SdaClient.new_agent(keystore), keystore, service)
 
-    recipient = new_client("tier-recipient")
-    rkey = recipient.new_encryption_key()
-    recipient.upload_agent()
-    recipient.upload_encryption_key(rkey)
-    agg = Aggregation(
-        id=AggregationId.random(),
-        title="check-metrics-tiered",
-        vector_dimension=4,
-        modulus=433,
-        recipient=recipient.agent.id,
-        recipient_key=rkey,
-        masking_scheme=ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
-        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=433),
-        recipient_encryption_scheme=SodiumEncryptionScheme(),
-        committee_encryption_scheme=SodiumEncryptionScheme(),
-        sub_cohort_size=2,
-        tiers=2,
+    def run_leg(leg: str, sharing, expect_children_ready: bool) -> None:
+        recipient = new_client(f"tier-{leg}-recipient")
+        rkey = recipient.new_encryption_key()
+        recipient.upload_agent()
+        recipient.upload_encryption_key(rkey)
+        agg = Aggregation(
+            id=AggregationId.random(),
+            title=f"check-metrics-tiered-{leg}",
+            vector_dimension=4,
+            modulus=433,
+            recipient=recipient.agent.id,
+            recipient_key=rkey,
+            masking_scheme=ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
+            committee_sharing_scheme=sharing,
+            recipient_encryption_scheme=SodiumEncryptionScheme(),
+            committee_encryption_scheme=SodiumEncryptionScheme(),
+            sub_cohort_size=2,
+            tiers=2,
+        )
+        pool = [new_client(f"tier-{leg}-clerk{i}") for i in range(2)]
+        for clerk in pool:
+            clerk.upload_agent()
+            clerk.upload_encryption_key(clerk.new_encryption_key())
+        round = setup_tier_round(
+            recipient, agg, lambda name: new_client(f"tier-{leg}-{name}"), pool
+        )
+        values = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
+        for i, v in enumerate(values):
+            p = new_client(f"tier-{leg}-part{i}")
+            p.upload_agent()
+            p.participate(v, agg.id)
+        out = run_tier_round(round).output.positive()
+        assert list(out.values) == [15, 18, 21, 24], \
+            f"tiered workload reveal disagrees ({leg})"
+        status = recipient.service.get_tier_status(recipient.agent, agg.id)
+        assert status is not None, "tier status route missing"
+        root = next(n for n in status.nodes if n.tier == 0)
+        assert root.result_ready, f"root not ready after the {leg} round"
+        children_ready = all(n.result_ready for n in status.nodes)
+        assert children_ready == expect_children_ready, \
+            f"unexpected child readiness under {leg} promotion"
+
+    # additive committees promote by reveal: every node clerks to a
+    # result, so the whole tree reports ready
+    run_leg("reveal", AdditiveSharing(share_count=2, modulus=433), True)
+    # Shamir committees share-promote: children never seal clerking
+    # results (their columns climb as tagged participations), only the
+    # root turns ready
+    run_leg(
+        "reshare",
+        BasicShamirSharing(share_count=2, privacy_threshold=1, prime_modulus=433),
+        False,
     )
-    pool = [new_client(f"tier-clerk{i}") for i in range(2)]
-    for clerk in pool:
-        clerk.upload_agent()
-        clerk.upload_encryption_key(clerk.new_encryption_key())
-    round = setup_tier_round(
-        recipient, agg, lambda name: new_client(f"tier-{name}"), pool
-    )
-    values = [[1, 2, 3, 4], [5, 6, 7, 8], [9, 10, 11, 12]]
-    for i, v in enumerate(values):
-        p = new_client(f"tier-part{i}")
-        p.upload_agent()
-        p.participate(v, agg.id)
-    out = run_tier_round(round).output.positive()
-    assert list(out.values) == [15, 18, 21, 24], "tiered workload reveal disagrees"
-    status = recipient.service.get_tier_status(recipient.agent, agg.id)
-    assert status is not None and all(n.result_ready for n in status.nodes), \
-        "tier status route disagrees with the finished round"
 
 
 def drive_sketch_round(base_url: str, tmp: str) -> None:
@@ -467,6 +494,14 @@ def main() -> int:
         errors.append(f"unexpected Content-Type: {content_type!r}")
     if not telemetry.spans(name="store.", trace_id="ci-check-metrics"):
         errors.append("trace id did not propagate into store spans")
+    for path in ("reveal", "reshare"):
+        if not re.search(
+            rf'^sda_tier_promotions_total\{{[^}}]*path="{path}"', body, re.M
+        ):
+            errors.append(
+                f'sda_tier_promotions_total missing the path="{path}" label '
+                "(one tiered round per promotion path must be driven)"
+            )
 
     if errors:
         print("check_metrics FAILED:", file=sys.stderr)

@@ -221,13 +221,16 @@ def flat_baseline(values: list) -> bytes:
 
 def tiered_aggregation(recipient, rkey, tiers: int, m: int, tag: str):
     from sda_tpu.protocol import (
-        AdditiveSharing,
         Aggregation,
         AggregationId,
+        BasicShamirSharing,
         ChaChaMasking,
         SodiumEncryptionScheme,
     )
 
+    # Shamir committees so the tier tree promotes over the default
+    # share-promotion path (clerks re-share upward; no per-node reveal
+    # round-trip) — the certification now covers the production path.
     return Aggregation(
         id=AggregationId.random(),
         title=f"flagship-{tag}",
@@ -238,7 +241,9 @@ def tiered_aggregation(recipient, rkey, tiers: int, m: int, tag: str):
         masking_scheme=ChaChaMasking(
             modulus=MODULUS, dimension=DIM, seed_bitsize=128
         ),
-        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=MODULUS),
+        committee_sharing_scheme=BasicShamirSharing(
+            share_count=2, privacy_threshold=1, prime_modulus=MODULUS
+        ),
         recipient_encryption_scheme=SodiumEncryptionScheme(),
         committee_encryption_scheme=SodiumEncryptionScheme(),
         sub_cohort_size=m,
@@ -251,6 +256,7 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
     plane, pace the cohort in on the arrival trace, run the round with
     EXTERNAL committees (the daemons), reveal, and hold the reveal
     byte-identical to the flat baseline over the same values."""
+    from sda_tpu import telemetry
     from sda_tpu.client import run_tier_round, setup_tier_round
 
     t0 = time.perf_counter()
@@ -258,16 +264,23 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
     recipient, rkey = ctx["recipient"], ctx["rkey"]
     trace, cursor = ctx["trace"], ctx["cursor"]
 
+    # every driver-side span this rung records carries one trace id, so
+    # scripts/trace_report.py can render the rung's stage waterfall from
+    # the banked artifact
+    trace_id = f"rung{rung}-c{cohort}"
+    telemetry.set_trace_id(trace_id)
+
     agg = tiered_aggregation(recipient, rkey, ctx["tiers"], ctx["fanout"],
                              f"rung{rung}")
 
     def new_promoter(name):
         return multi_root_client(tmp, f"rung{rung}-{name}", roots)
 
-    tround = setup_tier_round(
-        recipient, agg, new_promoter, ctx["pool"],
-        disjoint_committees=True, frontends=len(roots),
-    )
+    with telemetry.span("rung.provision", rung=rung, cohort=cohort):
+        tround = setup_tier_round(
+            recipient, agg, new_promoter, ctx["pool"],
+            disjoint_committees=True, frontends=len(roots),
+        )
     # placement is honored end to end: every node's stamped frontend is
     # exactly where the multi-root client homes that node's traffic
     for tn in tround.nodes:
@@ -280,32 +293,43 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
     # time; churned phones disconnect and retry at the end of the round
     deferred = []
     participants = ctx["participants"]
-    for i, v in enumerate(values):
-        k = cursor["index"]
-        cursor["index"] = k + 1
-        cursor["t"] = trace.next_arrival(k, cursor["t"])
-        delay = cursor["t0"] + cursor["t"] - time.perf_counter()
-        if delay > 0:
-            time.sleep(delay)
-        p = participants[i % len(participants)]
-        part = p.new_participations([v], agg.id)[0]
-        if trace.is_churned(k):
-            deferred.append((p, part))
-            continue
-        p.service.create_participation(p.agent, part)
-    for p, part in deferred:
-        p.service.create_participation(p.agent, part)
+    with telemetry.span("rung.arrivals", rung=rung, cohort=cohort):
+        for i, v in enumerate(values):
+            k = cursor["index"]
+            cursor["index"] = k + 1
+            cursor["t"] = trace.next_arrival(k, cursor["t"])
+            delay = cursor["t0"] + cursor["t"] - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            p = participants[i % len(participants)]
+            part = p.new_participations([v], agg.id)[0]
+            if trace.is_churned(k):
+                deferred.append((p, part))
+                continue
+            p.service.create_participation(p.agent, part)
+        for p, part in deferred:
+            p.service.create_participation(p.agent, part)
 
-    result = run_tier_round(
-        tround, external_clerks=True, poll_interval=0.1,
-        poll_timeout=ctx["poll_timeout"],
-    )
+    with telemetry.span("rung.round", rung=rung, cohort=cohort):
+        result = run_tier_round(
+            tround, external_clerks=True, poll_interval=0.1,
+            poll_timeout=ctx["poll_timeout"],
+        )
     out = result.output.positive()
     expected = [sum(v[d] for v in values) % MODULUS for d in range(DIM)]
     exact = [int(x) for x in out.values] == expected
-    flat = flat_baseline(values)
+    with telemetry.span("rung.baseline", rung=rung, cohort=cohort):
+        flat = flat_baseline(values)
     flat_match = out.values.tobytes() == flat
     elapsed = time.perf_counter() - t0
+    rung_spans = telemetry.spans(trace_id=trace_id)
+    telemetry.set_trace_id(None)
+    stages: dict = {}
+    for s in rung_spans:
+        if str(s.get("name", "")).startswith(("rung.", "tier.")):
+            stages[s["name"]] = round(
+                stages.get(s["name"], 0.0) + s["duration_s"], 4
+            )
     r = {
         "rung": rung,
         "cohort": cohort,
@@ -316,10 +340,16 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
         "flat_byte_match": flat_match,
         "aggregate": [int(x) for x in out.values],
         "skipped": [str(s) for s in result.skipped],
+        # driver-side stage totals; tier.* stages are nested inside
+        # rung.round, so the rung.* entries partition the wall and the
+        # tier.* entries break rung.round down further
+        "stages": stages,
+        "trace_id": trace_id,
         "placement": {
             str(tn.aggregation.id): tn.frontend for tn in tround.nodes
         },
         "_elapsed": elapsed,
+        "_spans": rung_spans,
     }
     if ctx["workload"] == "sketch":
         # the certified grid must also DECODE: count-min never
@@ -447,6 +477,8 @@ def main() -> int:
             "fanout": args.fanout,
             "multi_core_host": False,
         },
+        "committee_scheme": "basic-shamir x2 (t=1)",
+        "tier_path": "reshare",
         "trace": args.trace,
         "simulated_population": args.simulated_population,
     }
@@ -504,6 +536,7 @@ def main() -> int:
             }
 
             ladder: list = []
+            last_spans: list = []
             certified = 0
             cohort, rung = args.cohort_start, 0
             while cohort <= args.max_cohort:
@@ -513,6 +546,9 @@ def main() -> int:
                     break
                 r = run_rung(rung, cohort, ctx)
                 elapsed = r.pop("_elapsed")
+                # the deepest rung's span list is the profile worth
+                # banking: trace_report.py renders its waterfall
+                last_spans = r.pop("_spans")
                 certified_rung = (
                     r["exact"] and r["flat_byte_match"]
                     and not r["skipped"] and elapsed <= args.rung_deadline
@@ -530,6 +566,9 @@ def main() -> int:
                 rung += 1
 
             record["ladder"] = ladder
+            # the last (deepest) rung's driver-side span records, in the
+            # SpanLog shape scripts/trace_report.py consumes
+            record["spans"] = last_spans
             record["certified_max_cohort"] = certified
             record["scale_factor"] = (
                 round(args.simulated_population / certified, 1)

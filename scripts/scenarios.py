@@ -836,9 +836,11 @@ def _setup_tier_round(dep: Deployment, sharing, *, tiers: int, m: int,
 def scenario_sub_committee_clerk_killed(dep: Deployment, seed: int) -> dict:
     """One clerk of ONE sub-committee dies after ingest (never clerks,
     posts nothing — the vanish shape of vanish-after-sharing, one tier
-    down): the sub-committee's Shamir threshold reveals the partial sum
-    from the survivors, the promotion climbs, and the ROOT total is
-    byte-exact — a tier-local failure never poisons the hierarchy."""
+    down): under share-promotion (the Shamir default) the surviving
+    clerks re-issue their cached columns over the reduced survivor set
+    (epoch 1), the parent's prepare stage keeps that epoch, and the ROOT
+    total is byte-exact — a tier-local failure never poisons the
+    hierarchy, and nobody reveals a partial along the way."""
     from sda_tpu.client import run_tier_round
     from sda_tpu.protocol import BasicShamirSharing
 
@@ -872,6 +874,94 @@ def scenario_sub_committee_clerk_killed(dep: Deployment, seed: int) -> dict:
         "killed_sub_committee": str(victim_node.aggregation.id),
         "skipped": [str(s) for s in result.skipped],
         "aggregate": aggregate,
+    }
+
+
+def scenario_tier_reshare_clerk_death(dep: Deployment, seed: int) -> dict:
+    """Two-tier clerk-death matrix for the share-promotion path, both
+    sides of the reconstruction threshold. Phase SURVIVE: one of three
+    clerks dies (threshold 2) — the strict round re-issues from the
+    survivors (epoch 1) and the root is byte-exact over ALL participants,
+    with nothing skipped. Phase SKIP: two clerks die (below threshold) —
+    the lenient round drops exactly that subtree and the root reveals the
+    EXACT sum of the surviving sub-cohort's participants (never a
+    silently wrong total). Both phases also hold the no-reveal shape:
+    children never turn result_ready under share-promotion."""
+    from sda_tpu.client import run_tier_round
+    from sda_tpu.protocol import BasicShamirSharing
+
+    def sharing():
+        return BasicShamirSharing(
+            share_count=3, privacy_threshold=1, prime_modulus=MODULUS
+        )
+
+    def run_phase(tag: str, kill: int, strict: bool):
+        recipient, round, agg, tiers_mod = _setup_tier_round(
+            dep, sharing(), tiers=2, m=2, disjoint=True, tag=tag
+        )
+        by_leaf: dict = {}
+        for i in range(6):
+            p = dep.client(f"part{tag}-{i}")
+            p.upload_agent()
+            v = [(i + seed) % 5, (3 * i) % 7, 2, i % 4]
+            p.participate(v, agg.id)
+            by_leaf.setdefault(
+                tiers_mod.leaf_aggregation_id(agg, p.agent.id), []
+            ).append(v)
+        victim_node = round.nodes[1]
+        # disjoint committees: the killed clerks serve no other node, so
+        # dropping them from the drain IS their death — their jobs are
+        # never processed and no epoch-0 column ever lands
+        victim_node.clerks = victim_node.clerks[kill:]
+        result = run_tier_round(round, strict=strict)
+        status = recipient.service.get_tier_status(recipient.agent, agg.id)
+        if any(n.result_ready for n in status.nodes if n.tier > 0):
+            raise AssertionError(
+                "a share-promoted child sealed clerking results "
+                "(something revealed a partial)"
+            )
+        return by_leaf, victim_node, result
+
+    # phase SURVIVE: 2 of 3 clerks left >= threshold 2 -> epoch-1 reissue
+    by_leaf, victim_node, result = run_phase("-reshare-live", 1, strict=True)
+    if result.skipped:
+        raise AssertionError(f"strict survivable round skipped {result.skipped}")
+    full = [v for vals in by_leaf.values() for v in vals]
+    expected = [sum(v[d] for v in full) % MODULUS for d in range(DIM)]
+    aggregate = [int(v) for v in result.output.positive().values]
+    if aggregate != expected:
+        raise AssertionError(f"aggregate mismatch: got {aggregate}, want {expected}")
+
+    # phase SKIP: 1 of 3 clerks left < threshold 2 -> subtree dropped,
+    # root exact over the OTHER sub-cohort
+    by_leaf, victim_node, skip_result = run_phase("-reshare-dead", 2, strict=False)
+    if skip_result.skipped != [victim_node.aggregation.id]:
+        raise AssertionError(
+            f"expected skip of {victim_node.aggregation.id}, "
+            f"got {skip_result.skipped}"
+        )
+    survivors = [
+        v
+        for leaf, vals in by_leaf.items()
+        if leaf != victim_node.aggregation.id
+        for v in vals
+    ]
+    skip_expected = [sum(v[d] for v in survivors) % MODULUS for d in range(DIM)]
+    skip_aggregate = [int(v) for v in skip_result.output.positive().values]
+    if skip_aggregate != skip_expected:
+        raise AssertionError(
+            f"survivor aggregate mismatch: got {skip_aggregate}, "
+            f"want {skip_expected}"
+        )
+    return {
+        "tiers": 2,
+        "sub_cohorts": 2,
+        "committee": 3,
+        "threshold": 2,
+        "survive_aggregate": aggregate,
+        "skip_aggregate": skip_aggregate,
+        "skip_lost_participations": len(by_leaf.get(victim_node.aggregation.id, [])),
+        "skipped": [str(s) for s in skip_result.skipped],
     }
 
 
@@ -937,6 +1027,7 @@ SCENARIOS = {
     "saturated-frontend": scenario_saturated_frontend,
     "kill-shard-mid-round": scenario_kill_shard_mid_round,
     "sub-committee-clerk-killed": scenario_sub_committee_clerk_killed,
+    "tier-reshare-clerk-death": scenario_tier_reshare_clerk_death,
     "sub-cohort-vanishes": scenario_sub_cohort_vanishes,
 }
 

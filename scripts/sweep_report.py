@@ -49,7 +49,9 @@ measure_tier_fanout): one row per fan-out config (flat baseline + each
 against the flat N, mean stage seconds per clerk job, clerked inputs
 per clerk-second, and the honestly-reported single-core round wall —
 the evidence that hierarchical committees shrink the per-clerk bound
-even where one CPU serializes every committee.
+even where one CPU serializes every committee. Artifacts that carry the
+promotion A/B leg get a second table: per-node driver promotion latency
+under the reveal round-trip vs share-promotion, side by side.
 
 Also tabulates the sustained-soak rider artifacts (``soak-<stamp>.json``
 and the fault-axis variants ``replica-soak-*`` / ``grow-soak-*``, written
@@ -445,6 +447,61 @@ def print_tier(rows) -> None:
         )
 
 
+def load_promotion_ab(artdir: pathlib.Path):
+    """One row per promotion path per tier-*.json artifact carrying the
+    reveal-vs-share-promotion A/B leg (bench.py measure_tier_fanout):
+    per-node driver promotion latency, its inverse rate, the clerk-side
+    re-share cost reported alongside, and the reshare-vs-reveal ratio."""
+    rows = []
+    for f in sorted(artdir.glob("tier-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        ab = d.get("promotion_ab") if isinstance(d, dict) else None
+        if not isinstance(ab, dict):
+            continue
+        for path in ("reveal", "reshare"):
+            leg = ab.get(path)
+            if not isinstance(leg, dict):
+                continue
+            rows.append(
+                {
+                    "artifact": f.name,
+                    "path": path,
+                    "nodes": leg.get("promoted_nodes"),
+                    "per_node_s": leg.get("per_node_promotion_s"),
+                    "nodes_per_s": leg.get("promote_nodes_per_s"),
+                    "clerk_reshare_s": leg.get("clerk_reshare_s"),
+                    "wall_s": leg.get("wall_s"),
+                    "vs_reveal": leg.get("vs_reveal_per_node"),
+                    "exact": leg.get("exact"),
+                }
+            )
+    return rows
+
+
+def print_promotion_ab(rows) -> None:
+    print("\ntier promotion A/B (reveal vs share-promotion, tier-*.json):")
+    print(
+        f"{'path':>8} {'nodes':>5} {'node_s':>9} {'nodes/s':>8} "
+        f"{'clk_rshr_s':>10} {'wall_s':>7} {'vs_reveal':>9} {'exact':>5}  artifact"
+    )
+    for r in rows:
+        per_node = f"{r['per_node_s']:.5f}" if r["per_node_s"] is not None else "-"
+        exact = "-" if r["exact"] is None else ("yes" if r["exact"] else "NO")
+        print(
+            f"{r['path']:>8} "
+            f"{r['nodes'] if r['nodes'] is not None else '-':>5} "
+            f"{per_node:>9} "
+            f"{r['nodes_per_s'] if r['nodes_per_s'] is not None else '-':>8} "
+            f"{r['clerk_reshare_s'] if r['clerk_reshare_s'] is not None else '-':>10} "
+            f"{r['wall_s'] if r['wall_s'] is not None else '-':>7} "
+            f"{r['vs_reveal'] if r['vs_reveal'] is not None else '-':>9} "
+            f"{exact:>5}  {r['artifact']}"
+        )
+
+
 def load_soak(artdir: pathlib.Path):
     """One row per soak-family artifact (soak-* / replica-soak-* /
     grow-soak-*, scripts/load_soak.py): rounds and
@@ -757,6 +814,7 @@ def main() -> int:
     committee_rows = load_committee(artdir)
     wire_rows = load_wire(artdir)
     tier_rows = load_tier(artdir)
+    promotion_rows = load_promotion_ab(artdir)
     soak_rows = load_soak(artdir)
     flagship_rows = load_flagship(artdir)
     sketch_rows = load_sketch(artdir)
@@ -825,6 +883,8 @@ def main() -> int:
         print_wire(wire_rows)
     if tier_rows:
         print_tier(tier_rows)
+    if promotion_rows:
+        print_promotion_ab(promotion_rows)
     if soak_rows:
         print_soak(soak_rows)
     if flagship_rows:

@@ -117,8 +117,23 @@ class Participating(VerifiedKeys):
     def new_participation(self, values, aggregation_id, *, route: bool = True) -> Participation:
         return self.new_participations([values], aggregation_id, route=route)[0]
 
-    def new_participations(self, values_list, aggregation_id, *, route: bool = True) -> list:
+    def new_participations(
+        self,
+        values_list,
+        aggregation_id,
+        *,
+        route: bool = True,
+        ids=None,
+        tier_reshare=None,
+    ) -> list:
+        """``ids`` pins client-chosen participation ids (share-promotion
+        rows use deterministic uuid5 ids so re-drains collide idempotently
+        instead of double-counting); ``tier_reshare`` tags every built row
+        as a tier promotion (protocol.resources.TierReshare). Both default
+        off, leaving ordinary participations byte-unchanged."""
         secrets_rows = [np.asarray(v, dtype=np.int64) for v in values_list]
+        if ids is not None and len(ids) != len(secrets_rows):
+            raise ValueError("ids must match values_list one to one")
 
         aggregation = self.service.get_aggregation(self.agent, aggregation_id)
         if aggregation is None:
@@ -180,11 +195,12 @@ class Participating(VerifiedKeys):
 
         return [
             Participation(
-                id=ParticipationId.random(),
+                id=ids[i] if ids is not None else ParticipationId.random(),
                 participant=self.agent.id,
                 aggregation=aggregation.id,
                 recipient_encryption=recipient_encryptions[i],
                 clerk_encryptions=list(zip(clerk_ids, encryption_rows[i])),
+                tier_reshare=tier_reshare,
             )
             for i in range(len(secrets_rows))
         ]

@@ -3,30 +3,45 @@
 The client half of tiered aggregation (arXiv 2201.00864 via
 protocol/tiers.py): a tiered aggregation is a TREE of ordinary
 aggregations, and a round is the flat pipeline run once per node —
-leaves first — with each sub-committee's revealed partial sum PROMOTED
-one tier up as an ordinary participation. The server never cascades
+leaves first — with each sub-committee's aggregate PROMOTED one tier up
+as ordinary participations of the parent. The server never cascades
 anything; this module sequences the tree client-side, exactly like the
 flat flow sequences begin/participate/end/clerk/reveal.
 
-Roles per node: the root's recipient is the real recipient; every other
-node is owned by a PROMOTER — a throwaway agent that acts as the
-sub-aggregation's recipient (reveals the sub-cohort partial) and as a
-participant of the parent (re-submits it). Promoters therefore see their
-sub-cohort's partial sum in the clear; the paper's full scheme re-shares
-without revealing, which is future work (docs/ARCHITECTURE.md notes the
-deviation) — individual contributions remain protected by each leaf's
-masking + sharing either way.
+Two promotion paths (``protocol.tiers.effective_promotion``):
+
+* **Share-promotion** (``reshare`` — the default for Shamir-family
+  committee schemes): each sub-committee clerk expands its combined
+  share column through the precomputed Lagrange re-share row
+  (ops/shamir.reshare_coefficients / reshare_column) and submits the
+  result directly to the PARENT as an ordinary tagged participation
+  (client/clerk.py). The node's owner only submits a mask-correction
+  row — ``(m - sum of the sub-cohort's masks) % m`` — so the child-level
+  masks telescope out of the reshared columns; it never sees any
+  partial sum (the mask sum is data-independent). No plaintext exists
+  anywhere between the participants and the root recipient.
+
+* **Reveal-promotion** (``reveal`` — additive committees, and the A/B
+  baseline behind ``tier_promotion="reveal"``): the node's owner acts as
+  the sub-aggregation's recipient, reveals the sub-cohort partial, and
+  re-submits it to the parent. The owner sees the partial in the clear;
+  kept only because additive sharing has no Lagrange structure to
+  re-share through, and for benchmarking the old path.
 
 Exactness: every tier sums in the same modular group, so the root reveal
-equals the flat reveal byte-for-byte (partial residues are lifted to
-[0, m) with ``.positive()`` before promotion — the same lift the flat
-recipient applies at the end; tests/test_tiers.py holds the equality
-across schemes, stores, and transports).
+equals the flat reveal byte-for-byte under either path (re-shared
+columns are exact share expansions of the sub-cohort sum; revealed
+partials are lifted to [0, m) with ``.positive()`` before promotion —
+tests/test_tiers.py holds the equality across schemes, stores, and
+transports).
 
-Dropout tolerance composes per tier: within a sub-committee, Shamir-family
-sharing reveals from any ``reconstruction_threshold`` survivors
-(receive.require_reconstructible); a whole sub-cohort that vanishes is
-simply absent from the parent's snapshot cut under ``strict=False``, and
+Dropout tolerance composes per tier and now ACROSS tiers: within a
+sub-committee, Shamir-family sharing survives down to
+``reconstruction_threshold`` clerks — under share-promotion the
+surviving clerks re-issue their cached columns against the survivor set
+(epoch 1) and the parent's prepare stage keeps exactly one consistent
+epoch per child (server/snapshot.py). A sub-cohort that falls below
+threshold is absent from the parent's cut under ``strict=False``, and
 the root reveals the exact sum of the survivors.
 """
 
@@ -36,9 +51,23 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+import numpy as np
+
+from .. import telemetry
+from ..protocol import SdaError, TierReshare
 from ..protocol import tiers as tiers_mod
 from .committee import run_committee
 from .receive import RecipientOutput
+
+# driver-side critical-path latency of promoting one node into its
+# parent, labelled by path — the share-promotion A/B headline. Under
+# ``reveal`` a sample covers reveal_aggregation + promote_partial (mask
+# fold + clerk-column fetch/decrypt/reconstruct + re-submit); under
+# ``reshare`` it covers only the mask-correction row (and any epoch-1
+# re-issue), since the column expansion rides the clerk drain off the
+# driver's critical path (client/clerk.py, sda_tier_reshare_seconds).
+_PROMOTE_SERIES = "sda_tier_promote_seconds"
+_PROMOTE_HELP = "driver-side per-node tier promotion latency by path"
 
 
 @dataclass
@@ -180,6 +209,42 @@ def promote_partial(promoter, values, parent_aggregation_id):
     return parts[0].id
 
 
+def promote_mask_correction(
+    owner, node_aggregation, parent_aggregation_id, snapshot_id=None
+):
+    """Share-promotion's entire owner-side job: fold the node's snapshot
+    mask sum (data-independent — the owner learns nothing about the
+    values) and submit ``(m - mask_sum) % m`` to the parent as a tagged
+    ordinary participation, cancelling the child-level masks still
+    embedded in the clerks' re-shared columns. No-op when the node's
+    masking scheme carries no mask. The row's id is deterministic
+    (``protocol.tiers.reshare_participation_id``) so replays collide
+    idempotently; returns the participation id, or None when skipped.
+    ``snapshot_id`` (``end_aggregation``'s return) skips the
+    status/record rediscovery round-trips on this critical path."""
+    if not node_aggregation.masking_scheme.has_mask():
+        return None
+    mask = owner.combined_snapshot_mask(
+        node_aggregation.id, aggregation=node_aggregation, snapshot_id=snapshot_id
+    )
+    if mask.size == 0:
+        # empty sub-cohort under a sealed-mask scheme: nothing was
+        # folded, the correction is exactly zero
+        mask = np.zeros(node_aggregation.vector_dimension, dtype=np.int64)
+    correction = (node_aggregation.modulus - mask) % node_aggregation.modulus
+    tag = TierReshare(child=node_aggregation.id, epoch=0)
+    pid = tiers_mod.reshare_participation_id(node_aggregation.id, 0)
+    parts = owner.new_participations(
+        [correction], parent_aggregation_id, route=False, ids=[pid], tier_reshare=tag
+    )
+    try:
+        owner.upload_participations(parts)
+    except Exception as e:
+        if "already exists" not in str(e):
+            raise
+    return pid
+
+
 def _await_results(entries, poll_interval: float, deadline: float) -> None:
     """External-clerks drain: the committees run as separate ``sdad
     committee`` daemon processes over the wire, so instead of running
@@ -224,6 +289,96 @@ def _drain_clerks(entries, max_iterations: int) -> None:
     run_committee(clerks, max_iterations)
 
 
+def _ensure_reshared(tn: TierRoundNode) -> None:
+    """In-process survivor check after a share-promotion drain: if every
+    committee clerk is still attached to the node, the epoch-0 columns
+    (full committee, exact by construction) already landed in the parent
+    and nothing remains. Otherwise the survivors — who each cached their
+    combined column while processing their clerking job — re-issue
+    against the surviving position set as epoch 1; the parent's prepare
+    stage keeps the highest complete epoch and discards the rest. Raises
+    SdaError when the survivors cannot reconstruct (below threshold):
+    the caller skips or aborts per ``strict``."""
+    scheme = tn.aggregation.committee_sharing_scheme
+    if len(tn.clerks) == scheme.output_size:
+        # full committee still attached (setup elected exactly these
+        # clerks): the epoch-0 columns already landed during the drain,
+        # so skip the committee fetch on the no-death fast path
+        return
+    committee = tn.owner.service.get_committee(tn.owner.agent, tn.aggregation.id)
+    if committee is None:
+        raise SdaError(f"no committee for tier node {tn.aggregation.id}")
+    positions = {
+        clerk_id: ix for ix, (clerk_id, _) in enumerate(committee.clerks_and_keys)
+    }
+    survivors = sorted(
+        positions[c.agent.id] for c in tn.clerks if c.agent.id in positions
+    )
+    if len(survivors) == scheme.output_size:
+        return
+    if len(survivors) < scheme.reconstruction_threshold:
+        raise SdaError(
+            f"tier node {tn.aggregation.id}: {len(survivors)} surviving "
+            f"clerks cannot re-share (threshold "
+            f"{scheme.reconstruction_threshold})"
+        )
+    for clerk in tn.clerks:
+        if clerk.agent.id in positions:
+            clerk.reshare_tier_child(tn.aggregation, survivors, epoch=1)
+
+
+def _await_promotions(
+    round: TierRound,
+    entries,
+    poll_interval: float,
+    deadline: float,
+    strict: bool,
+    skipped: list,
+) -> None:
+    """External-clerks wait for share-promotion: the committees run as
+    separate daemons, so the driver polls each PARENT's participation
+    count until every live child's promotion rows have landed —
+    ``share_count`` tagged columns per child plus one mask-correction
+    row when the scheme masks. Children never turn ``result_ready``
+    under share-promotion (their clerks submit upward instead of sealing
+    clerking results), which is why this polls the parent instead of
+    ``_await_results``. On timeout, ``strict`` raises; otherwise the
+    round proceeds and the parent's prepare stage drops whichever
+    children stayed incomplete — which child stalled cannot be
+    attributed from out here (the count is per parent), so every child
+    of a stalled parent is recorded in ``skipped`` conservatively; the
+    root total remains the exact sum over the complete children."""
+    per_child = round.root.committee_sharing_scheme.output_size
+    if round.root.masking_scheme.has_mask():
+        per_child += 1
+    by_parent: dict = {}
+    for tn in entries:
+        by_parent.setdefault(tn.node.parent, []).append(tn)
+    waiting = {parent: len(children) * per_child for parent, children in by_parent.items()}
+    while waiting:
+        done = []
+        for parent_id, expected in waiting.items():
+            owner = round.node(parent_id).owner
+            status = owner.service.get_aggregation_status(owner.agent, parent_id)
+            if status is not None and status.number_of_participations >= expected:
+                done.append(parent_id)
+        for parent_id in done:
+            del waiting[parent_id]
+        if not waiting:
+            return
+        if time.monotonic() > deadline:
+            ids = [str(p) for p in waiting]
+            if strict:
+                raise TimeoutError(
+                    f"tier promotions did not land in parents: {ids}"
+                )
+            for parent_id in waiting:
+                for tn in by_parent[parent_id]:
+                    skipped.append(tn.aggregation.id)
+            return
+        time.sleep(poll_interval)
+
+
 def run_tier_round(
     round: TierRound,
     *,
@@ -236,24 +391,50 @@ def run_tier_round(
     """Run a provisioned tiered round bottom-up and reveal the root.
 
     Per tier, deepest first: close every node (freezing its sub-cohort's
-    participations into a snapshot), drain that tier's clerks, then each
-    promoter reveals its partial sum — lifted to ``[0, modulus)`` — and
-    promotes it into the parent. The root closes last, over exactly its
-    children's promotions, and the real recipient reveals the total.
+    participations into a snapshot), then promote it into the parent
+    along the round's path (``protocol.tiers.effective_promotion``):
+
+    * ``reshare`` (default for Shamir-family schemes): the node's owner
+      submits only the mask-correction row; the tier's clerks — drained
+      next — expand their combined columns through the Lagrange re-share
+      row straight into the parent (client/clerk.py). After the drain,
+      ``_ensure_reshared`` re-issues from the survivors (epoch 1) when
+      clerks died, so the round survives any sub-committee down to its
+      reconstruction threshold without anyone revealing a partial.
+
+    * ``reveal`` (additive committees / A/B baseline): drain the tier's
+      clerks, then each owner reveals its partial sum — lifted to
+      ``[0, modulus)`` — and re-submits it to the parent.
+
+    The root closes last, over exactly its children's promotions, and
+    the real recipient reveals the total. Per-node promotion latency is
+    observed into ``sda_tier_promote_seconds{path=...}`` either way.
 
     ``strict=False`` tolerates failed sub-aggregations (vanished
-    sub-cohort, unrevealable sub-committee): they are recorded in
+    sub-cohort, sub-committee below threshold): they are recorded in
     ``TierRoundResult.skipped`` and the root reveals the exact sum of
     the survivors. Under ``strict=True`` any sub-tier failure raises.
 
     ``external_clerks=True`` is the process-spanning mode: committees
     run as separate ``sdad committee`` daemons over the wire, so the
-    driver never runs a clerk loop in-process — it just waits (up to
-    ``poll_timeout`` seconds per tier) for each closed node's snapshot
-    to report ``result_ready`` before revealing.
+    driver never runs a clerk loop in-process — per tier it waits (up to
+    ``poll_timeout`` seconds) for the daemons to finish: under reveal,
+    for each closed node's snapshot to report ``result_ready``; under
+    share-promotion, for each parent's participation count to reach its
+    children's expected promotion rows (children never turn
+    ``result_ready`` on this path — their clerks submit upward instead
+    of sealing clerking results).
     """
     depth = tiers_mod.tier_depth(round.root)
+    reshare = (
+        tiers_mod.effective_promotion(round.root) == tiers_mod.PROMOTION_RESHARE
+    )
     skipped = []
+    promote_hist = telemetry.histogram(
+        _PROMOTE_SERIES,
+        _PROMOTE_HELP,
+        path=tiers_mod.PROMOTION_RESHARE if reshare else tiers_mod.PROMOTION_REVEAL,
+    )
 
     def _drain(entries):
         if external_clerks:
@@ -263,29 +444,83 @@ def run_tier_round(
         else:
             _drain_clerks(entries, max_iterations)
 
+    path_label = (
+        tiers_mod.PROMOTION_RESHARE if reshare else tiers_mod.PROMOTION_REVEAL
+    )
     for tier in range(depth - 1, 0, -1):
         entries = [tn for tn in round.nodes if tn.node.tier == tier]
         live = []
-        for tn in entries:
-            try:
-                tn.owner.end_aggregation(tn.aggregation.id)
-            except Exception:
-                if strict:
-                    raise
-                skipped.append(tn.aggregation.id)
-                continue
-            live.append(tn)
-        _drain(live)
-        for tn in live:
-            try:
-                partial = tn.owner.reveal_aggregation(tn.aggregation.id).positive()
-            except Exception:
-                if strict:
-                    raise
-                skipped.append(tn.aggregation.id)
-                continue
-            promote_partial(tn.owner, partial.values, tn.node.parent)
-    round.recipient.end_aggregation(round.root.id)
-    _drain([round.nodes[0]])
-    output = round.recipient.reveal_aggregation(round.root.id)
+        with telemetry.span(
+            "tier.close", tier=tier, nodes=len(entries), path=path_label
+        ):
+            for tn in entries:
+                try:
+                    # closing the node (snapshot pipeline) is common to
+                    # both paths and untimed; only the promotion work
+                    # itself is observed, so the per-path samples
+                    # compare like for like
+                    snapshot_id = tn.owner.end_aggregation(tn.aggregation.id)
+                    if reshare:
+                        t0 = time.perf_counter()
+                        try:
+                            promote_mask_correction(
+                                tn.owner,
+                                tn.aggregation,
+                                tn.node.parent,
+                                snapshot_id=snapshot_id,
+                            )
+                        finally:
+                            promote_hist.observe(time.perf_counter() - t0)
+                except Exception:
+                    if strict:
+                        raise
+                    skipped.append(tn.aggregation.id)
+                    continue
+                live.append(tn)
+        with telemetry.span(
+            "tier.promote", tier=tier, nodes=len(live), path=path_label
+        ):
+            if not reshare:
+                _drain(live)
+                for tn in live:
+                    t0 = time.perf_counter()
+                    try:
+                        partial = tn.owner.reveal_aggregation(
+                            tn.aggregation.id
+                        ).positive()
+                        promote_partial(tn.owner, partial.values, tn.node.parent)
+                    except Exception:
+                        if strict:
+                            raise
+                        skipped.append(tn.aggregation.id)
+                        continue
+                    finally:
+                        promote_hist.observe(time.perf_counter() - t0)
+            elif external_clerks:
+                _await_promotions(
+                    round,
+                    live,
+                    poll_interval,
+                    time.monotonic() + poll_timeout,
+                    strict,
+                    skipped,
+                )
+            else:
+                _drain_clerks(live, max_iterations)
+                for tn in live:
+                    t0 = time.perf_counter()
+                    try:
+                        _ensure_reshared(tn)
+                    except Exception:
+                        if strict:
+                            raise
+                        skipped.append(tn.aggregation.id)
+                        continue
+                    finally:
+                        promote_hist.observe(time.perf_counter() - t0)
+    with telemetry.span("tier.root_close", path=path_label):
+        round.recipient.end_aggregation(round.root.id)
+        _drain([round.nodes[0]])
+    with telemetry.span("tier.root_reveal", path=path_label):
+        output = round.recipient.reveal_aggregation(round.root.id)
     return TierRoundResult(output=output, skipped=skipped)

@@ -110,6 +110,18 @@ class SdaService(abc.ABC):
     def create_clerking_result(self, caller, result) -> None:
         """Push the result of a finished clerking job."""
 
+    def complete_clerking_job(self, caller, job_id) -> None:
+        """Retire a clerking job the caller owns WITHOUT filing a result —
+        the terminal of tier share-promotion (client/clerk.py), where the
+        clerk's output left as tagged participations of the parent and no
+        recipient-sealed result may exist. Idempotent on replay. Default
+        shim raises so ``SdaService`` bindings predating share promotion
+        keep importing; reaching it means a binding/version mismatch."""
+        raise NotImplementedError(
+            "this SdaService binding does not support completing a job "
+            "without a clerking result"
+        )
+
     # -- recipient (methods.rs:87-112) ----------------------------------------
 
     @abc.abstractmethod

@@ -121,6 +121,8 @@ class Aggregation:
     committee_encryption_scheme: AdditiveEncryptionScheme
     sub_cohort_size: Optional[int] = None  # fan-out m per tiered node
     tiers: Optional[int] = None  # committee tiers; absent/1 = flat
+    tier_parent: Optional[AggregationId] = None  # set on derived children
+    tier_promotion: Optional[str] = None  # "reveal" | "reshare"; absent = auto
 
     def is_tiered(self) -> bool:
         return (self.tiers or 1) > 1
@@ -142,6 +144,10 @@ class Aggregation:
             obj["sub_cohort_size"] = self.sub_cohort_size
         if self.tiers is not None:
             obj["tiers"] = self.tiers
+        if self.tier_parent is not None:
+            obj["tier_parent"] = self.tier_parent.to_json()
+        if self.tier_promotion is not None:
+            obj["tier_promotion"] = self.tier_promotion
         return obj
 
     @classmethod
@@ -165,6 +171,8 @@ class Aggregation:
             ),
             sub_cohort_size=_opt(obj.get("sub_cohort_size"), int),
             tiers=_opt(obj.get("tiers"), int),
+            tier_parent=_opt(obj.get("tier_parent"), AggregationId.from_json),
+            tier_promotion=obj.get("tier_promotion"),
         )
 
 
@@ -213,10 +221,52 @@ class Committee:
 
 
 @dataclass
+class TierReshare:
+    """Share-promotion tag on a participation climbing the tier tree
+    (arXiv 2201.00864: re-share shares upward, never reveal).
+
+    ``position`` is the submitting clerk's 0-based seat in ``child``'s
+    committee for a re-shared column row, or None for the mask-correction
+    row the child's owner submits (which carries only the negated mask
+    sum — data-independent, no aggregate content). ``survivors`` is the
+    consistent 0-based seat set the Lagrange weights of this ``epoch``
+    were computed over (None on mask rows). The tagged participation is
+    otherwise an ordinary one — freshly masked, shared, and sealed for
+    the PARENT aggregation — so flat records and parent-side clerking
+    stay byte-unchanged."""
+
+    child: AggregationId
+    epoch: int
+    position: Optional[int] = None
+    survivors: Optional[list] = None  # list[int], sorted
+
+    def to_json(self):
+        obj = {"child": self.child.to_json(), "epoch": self.epoch}
+        if self.position is not None:
+            obj["position"] = self.position
+        if self.survivors is not None:
+            obj["survivors"] = [int(s) for s in self.survivors]
+        return obj
+
+    @classmethod
+    def from_json(cls, obj):
+        survivors = obj.get("survivors")
+        return cls(
+            child=AggregationId.from_json(obj["child"]),
+            epoch=int(obj["epoch"]),
+            position=_opt(obj.get("position"), int),
+            survivors=None if survivors is None else [int(s) for s in survivors],
+        )
+
+
+@dataclass
 class Participation:
     """A participant's input to an aggregation (resources.rs:92-108).
 
     ``id`` is client-chosen so retries are idempotent (resources.rs:93-101).
+    ``tier_reshare`` marks a share-promotion row of the hierarchical plane
+    and is emitted only when set, so flat participations keep the original
+    five-key wire shape byte for byte.
     """
 
     id: ParticipationId
@@ -224,9 +274,10 @@ class Participation:
     aggregation: AggregationId
     recipient_encryption: Optional[Encryption]
     clerk_encryptions: list  # list[tuple[AgentId, Encryption]]
+    tier_reshare: Optional[TierReshare] = None
 
     def to_json(self):
-        return {
+        obj = {
             "id": self.id.to_json(),
             "participant": self.participant.to_json(),
             "aggregation": self.aggregation.to_json(),
@@ -235,6 +286,9 @@ class Participation:
                 [a.to_json(), e.to_json()] for (a, e) in self.clerk_encryptions
             ],
         }
+        if self.tier_reshare is not None:
+            obj["tier_reshare"] = self.tier_reshare.to_json()
+        return obj
 
     @classmethod
     def from_json(cls, obj):
@@ -247,6 +301,7 @@ class Participation:
                 (AgentId.from_json(a), Encryption.from_json(e))
                 for (a, e) in obj["clerk_encryptions"]
             ],
+            tier_reshare=_opt(obj.get("tier_reshare"), TierReshare.from_json),
         )
 
 

@@ -28,9 +28,9 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ..utils.hashring import HashRing
-from .ids import AggregationId
+from .ids import AggregationId, ParticipationId
 from .resources import Aggregation
-from .schemes import SodiumEncryptionScheme
+from .schemes import AdditiveSharing, SodiumEncryptionScheme
 
 #: uuid5 namespace for everything tier-derived (child ids, cohort hashes).
 #: Fixed forever: child ids must be reproducible by any client or server
@@ -41,6 +41,52 @@ TIER_NAMESPACE = uuid.UUID("8f3f6d2a-94b1-4dfd-b1b5-6a42a86be1a4")
 #: so both knobs are capped to keep the derived fan-out enumerable
 MAX_TIERS = 4
 MAX_SUB_COHORTS = 64
+
+#: how partial sums climb the tree. ``reveal`` is the PR-14 path (the
+#: promoter reconstructs the sub-cohort partial and re-submits it);
+#: ``reshare`` is the paper's share-promotion path (clerks re-share their
+#: aggregated columns upward; nothing intermediate is ever reconstructed).
+PROMOTION_REVEAL = "reveal"
+PROMOTION_RESHARE = "reshare"
+
+#: re-share epochs are tiny (0 = full committee, 1 = survivor reissue);
+#: the bound keeps the deterministic id space and validation enumerable
+MAX_RESHARE_EPOCHS = 16
+
+
+def effective_promotion(aggregation: Aggregation) -> str:
+    """The promotion path a tiered round actually runs. Explicit
+    ``tier_promotion`` wins; otherwise share-promotion is the default for
+    every threshold scheme and additive sharing falls back to reveal
+    (additive columns are the secrets' full image — there is no Lagrange
+    column to re-share by, and ``reconstruction_matrix`` has no additive
+    form)."""
+    if aggregation.tier_promotion is not None:
+        return aggregation.tier_promotion
+    if isinstance(aggregation.committee_sharing_scheme, AdditiveSharing):
+        return PROMOTION_REVEAL
+    return PROMOTION_RESHARE
+
+
+def is_reshare_child(aggregation: Aggregation) -> bool:
+    """True when ``aggregation`` is a derived tier child whose clerks must
+    promote their aggregated share columns to ``tier_parent`` instead of
+    sealing clerking results for a local reveal."""
+    return (
+        aggregation.tier_parent is not None
+        and effective_promotion(aggregation) == PROMOTION_RESHARE
+    )
+
+
+def reshare_participation_id(
+    child_id: AggregationId, epoch: int, position: Optional[int] = None
+) -> ParticipationId:
+    """Deterministic id for a share-promotion row: uuid5 of (child, epoch,
+    committee position), or of (child,) alone for the owner's single
+    mask-correction row. Retries and re-drains therefore collide on the
+    stores' create-if-identical semantics instead of double-counting."""
+    leaf = "reshare-mask" if position is None else f"reshare:{epoch}:{position}"
+    return ParticipationId(uuid.uuid5(TIER_NAMESPACE, f"{child_id}:{leaf}"))
 
 
 def tier_depth(aggregation: Aggregation) -> int:
@@ -148,11 +194,14 @@ def child_aggregation(
     """The derived sub-aggregation record for child ``index`` of
     ``parent``: same group (modulus, dimension), same masking and sharing
     schemes (so every tier gets the same dropout tolerance), one fewer
-    tier. The child's recipient is its PROMOTER — the agent that reveals
-    the sub-cohort's partial sum and re-submits it one tier up — so the
-    recipient encryption scheme is pinned to sodium sealed boxes
-    (promoter keystores hold sodium keys; PackedPaillier mask transport
-    stays a root-only concern)."""
+    tier. The child's recipient is its OWNER — under share-promotion it
+    only ever decrypts the sub-cohort's mask sum (to submit the
+    mask-correction row); under reveal-promotion it reconstructs and
+    re-submits the partial. Either way the recipient encryption scheme is
+    pinned to sodium sealed boxes (owner keystores hold sodium keys;
+    PackedPaillier mask transport stays a root-only concern).
+    ``tier_parent``/``tier_promotion`` propagate so a child record alone
+    tells its clerks where and how to promote."""
     remaining = tier_depth(parent) - 1
     return Aggregation(
         id=child_aggregation_id(parent.id, index),
@@ -167,4 +216,6 @@ def child_aggregation(
         committee_encryption_scheme=parent.committee_encryption_scheme,
         sub_cohort_size=parent.sub_cohort_size if remaining > 1 else None,
         tiers=remaining if remaining > 1 else None,
+        tier_parent=parent.id,
+        tier_promotion=parent.tier_promotion,
     )

@@ -537,8 +537,12 @@ class SdaHttpClient(SdaService):
         the interface's sequential (non-atomic) default. The body is one
         binary wire frame by default (columns of raw sealed boxes, no
         base64, no per-field JSON); ``SDA_WIRE=json`` restores the legacy
-        JSON array for old servers."""
-        if wire.mode() == "binary":
+        JSON array for old servers. Tier-promotion rows (tier_reshare
+        tagged — client/clerk.py, client/tiers.py) always go as the JSON
+        body: the binary frame has no tag column, and tagged batches are
+        a handful of rows per committee, never the ingest hot path."""
+        tagged = any(p.tier_reshare is not None for p in participations)
+        if wire.mode() == "binary" and not tagged:
             self._request(
                 "POST",
                 "/v1/aggregations/participations/batch",
@@ -585,4 +589,13 @@ class SdaHttpClient(SdaService):
             result,
             idempotent=True,
             route_key=result.job,
+        )
+
+    def complete_clerking_job(self, caller, job_id) -> None:
+        self._request(
+            "POST",
+            f"/v1/aggregations/implied/jobs/{quote(str(job_id))}/complete",
+            caller,
+            idempotent=True,
+            route_key=job_id,
         )

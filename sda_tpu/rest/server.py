@@ -743,6 +743,16 @@ class _RequestContext:
             self._send(201)
             return True
 
+        if method == "POST" and (
+            match := m(rf"/v1/aggregations/implied/jobs/({_UUID})/complete")
+        ):
+            # resultless retirement (tier share-promotion): the clerk's
+            # output went upward as tagged participations, so the job is
+            # marked done with nothing to file. Bodyless + idempotent.
+            svc.complete_clerking_job(self._caller(), ClerkingJobId(match.group(1)))
+            self._send(201)
+            return True
+
         if method == "GET" and (
             match := m(rf"/v1/aggregations/({_UUID})/snapshots/({_UUID})/result/masks/(\d+)")
         ):

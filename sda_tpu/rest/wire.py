@@ -288,6 +288,12 @@ def encode_participations(participations) -> bytes:
     (per-item clerk counts as an i64 column, clerk agent ids, and the
     ciphertexts in the same flattened order)."""
     ps = list(participations)
+    for p in ps:
+        if getattr(p, "tier_reshare", None) is not None:
+            # the frame has no tag column; silently encoding a tagged row
+            # would strip its promotion semantics server-side. Callers
+            # route tagged batches through the JSON body (rest/client.py).
+            raise WireError("tier_reshare-tagged participations have no binary encoding")
     parts = [_header(KIND_PARTICIPATIONS), _uvarint(len(ps))]
     if ps:
         _put_uuid_column(parts, [p.id for p in ps])

@@ -237,6 +237,11 @@ class FileAggregationsStore(AggregationsStore):
                 continue  # raced a concurrent delete — nothing to copy
             yield Participation.from_json(payload)
 
+    def discard_participations(self, aggregation_id, participation_ids) -> None:
+        table = self._participations(aggregation_id)
+        for pid in participation_ids:
+            table.delete(pid)
+
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         # write-once: a retry after a partial snapshot must not re-freeze a
         # different membership (participations may have arrived in between)
@@ -632,6 +637,15 @@ class FileClerkingJobsStore(ClerkingJobsStore):
         # move queue -> done so the job is no longer pollable but stays auditable
         self._done(job.clerk).put(job.id, payload)
         self._queue(job.clerk).delete(job.id)
+
+    def complete_clerking_job(self, clerk_id, job_id) -> None:
+        payload = self._queue(clerk_id).get(job_id)
+        if payload is None:
+            if self._done(clerk_id).get(job_id) is not None:
+                return  # already retired — idempotent replay
+            raise InvalidRequestError(f"no job {job_id}")
+        self._done(clerk_id).put(job_id, payload)
+        self._queue(clerk_id).delete(job_id)
 
     def list_results(self, snapshot_id) -> list:
         return [ClerkingJobId(j) for j in self._results(snapshot_id).list_ids()]

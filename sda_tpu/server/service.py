@@ -157,6 +157,49 @@ class SdaServer:
                 "sda_tier_depth",
                 "committee levels of the most recently created tiered aggregation",
             ).set(t)
+        if aggregation.tier_promotion is not None:
+            if aggregation.tier_promotion not in (
+                tiers_mod.PROMOTION_REVEAL,
+                tiers_mod.PROMOTION_RESHARE,
+            ):
+                raise InvalidRequestError(
+                    f"tier_promotion must be "
+                    f"{tiers_mod.PROMOTION_REVEAL!r} or "
+                    f"{tiers_mod.PROMOTION_RESHARE!r}"
+                )
+            # the knob only means something on the hierarchical plane: a
+            # root (tiers set) or a derived child (tier_parent set — leaves
+            # carry tiers=None but still promote)
+            if aggregation.tiers is None and aggregation.tier_parent is None:
+                raise InvalidRequestError(
+                    "tier_promotion requires a tiered aggregation"
+                )
+            from ..protocol import AdditiveSharing
+
+            if aggregation.tier_promotion == tiers_mod.PROMOTION_RESHARE and isinstance(
+                aggregation.committee_sharing_scheme, AdditiveSharing
+            ):
+                # an additive clerk column has no Lagrange weight to
+                # re-share by — there is no share-promotion linear map
+                raise InvalidRequestError(
+                    "share-promotion requires a threshold (Shamir-family) "
+                    "committee sharing scheme; additive sharing promotes "
+                    "by reveal only"
+                )
+        if aggregation.tier_parent is not None:
+            parent = self.aggregation_store.get_aggregation(aggregation.tier_parent)
+            if parent is None or not parent.is_tiered():
+                raise InvalidRequestError(
+                    "tier_parent must name an existing tiered aggregation"
+                )
+            children = {
+                tiers_mod.child_aggregation_id(parent.id, ix)
+                for ix in range(parent.sub_cohort_size)
+            }
+            if aggregation.id not in children:
+                raise InvalidRequestError(
+                    "aggregation is not a derived child of its tier_parent"
+                )
         self.aggregation_store.create_aggregation(aggregation)
 
     def delete_aggregation(self, aggregation_id) -> None:
@@ -259,13 +302,101 @@ class SdaServer:
                     "clerk encryptions must be sodium sealed boxes"
                 )
         self._validate_recipient_encryption(participation, agg)
+        if participation.tier_reshare is not None:
+            self._validate_tier_reshare(participation, agg)
+
+    def _validate_tier_reshare(self, participation, agg) -> None:
+        """Gate share-promotion rows at the door: a tagged row must target
+        a tiered parent, name one of its derived children, carry a sane
+        epoch/position/survivor set, and be submitted by the identity the
+        tag claims (the child's clerk at ``position``, or the child's
+        owner for the mask-correction row). Late rows — arriving after the
+        parent froze a snapshot — are rejected so the prepare stage's
+        epoch resolution stays pinned."""
+        tag = participation.tier_reshare
+        if agg is None:
+            return  # the store write will surface the missing aggregation
+        if not agg.is_tiered():
+            raise InvalidRequestError(
+                "tier_reshare rows may only target tiered aggregations"
+            )
+        children = {
+            tiers_mod.child_aggregation_id(agg.id, ix)
+            for ix in range(agg.sub_cohort_size)
+        }
+        if tag.child not in children:
+            raise InvalidRequestError(
+                "tier_reshare child is not a derived child of the aggregation"
+            )
+        if not 0 <= tag.epoch < tiers_mod.MAX_RESHARE_EPOCHS:
+            raise InvalidRequestError(
+                f"tier_reshare epoch must be in [0, {tiers_mod.MAX_RESHARE_EPOCHS})"
+            )
+        child = self.aggregation_store.get_aggregation(tag.child)
+        if child is None:
+            raise InvalidRequestError(
+                "tier_reshare child aggregation is not provisioned"
+            )
+        if tag.position is None:
+            # mask-correction row: the child's owner cancels its
+            # sub-cohort's mask sum one tier up
+            if tag.survivors is not None:
+                raise InvalidRequestError(
+                    "tier_reshare mask rows carry no survivor set"
+                )
+            if not agg.masking_scheme.has_mask():
+                raise InvalidRequestError(
+                    "tier_reshare mask row for a maskless aggregation"
+                )
+            if participation.participant != child.recipient:
+                raise InvalidRequestError(
+                    "tier_reshare mask row must come from the child's owner"
+                )
+        else:
+            n = child.committee_sharing_scheme.output_size
+            threshold = child.committee_sharing_scheme.reconstruction_threshold
+            survivors = tag.survivors
+            if survivors is None:
+                raise InvalidRequestError(
+                    "tier_reshare column rows must carry their survivor set"
+                )
+            if len(set(survivors)) != len(survivors) or any(
+                not 0 <= s < n for s in survivors
+            ):
+                raise InvalidRequestError(
+                    "tier_reshare survivors must be distinct committee positions"
+                )
+            if len(survivors) < threshold:
+                raise InvalidRequestError(
+                    f"tier_reshare survivor set below the reconstruction "
+                    f"threshold {threshold}"
+                )
+            if tag.position not in survivors:
+                raise InvalidRequestError(
+                    "tier_reshare position must be among the survivors"
+                )
+            child_committee = self.aggregation_store.get_committee(tag.child)
+            if child_committee is None:
+                raise InvalidRequestError(
+                    "tier_reshare child has no committee"
+                )
+            clerk, _ = child_committee.clerks_and_keys[tag.position]
+            if participation.participant != clerk:
+                raise InvalidRequestError(
+                    "tier_reshare column row must come from the child's "
+                    "clerk at the claimed position"
+                )
+        if self.aggregation_store.list_snapshots(participation.aggregation):
+            raise InvalidRequestError(
+                "tier_reshare row arrived after the aggregation snapshotted"
+            )
 
     def create_participation(self, participation) -> None:
         committee = self.aggregation_store.get_committee(participation.aggregation)
         agg = self.aggregation_store.get_aggregation(participation.aggregation)
         self._validate_participation(participation, committee, agg)
         self.aggregation_store.create_participation(participation)
-        self._count_promotion(agg, 1)
+        self._count_promotion(agg, [participation])
 
     def create_participations(self, participations) -> None:
         """Batched ingest: every item passes the exact single-item checks
@@ -287,20 +418,30 @@ class SdaServer:
             self._validate_participation(p, committees[a], aggs[a], expected.get(a))
         self.aggregation_store.create_participations(participations)
         for a, agg in aggs.items():
-            self._count_promotion(agg, sum(1 for p in participations if p.aggregation == a))
+            self._count_promotion(agg, [p for p in participations if p.aggregation == a])
 
     @staticmethod
-    def _count_promotion(agg, n: int) -> None:
+    def _count_promotion(agg, participations) -> None:
         """Every participation accepted into a TIERED aggregation is a
         promotion by construction: real participants route to leaf
         sub-aggregations (which are flat), so anything landing on a node
-        with tiers > 1 is a sub-committee's revealed partial sum climbing
-        one level (client/tiers.py)."""
-        if n and agg is not None and agg.is_tiered():
+        with tiers > 1 is a sub-cohort's partial climbing one level
+        (client/tiers.py). ``path`` distinguishes the PR-14 reveal rows
+        (untagged re-submissions of a reconstructed partial) from
+        share-promotion rows (tier_reshare-tagged columns + mask
+        corrections)."""
+        if agg is None or not agg.is_tiered():
+            return
+        counts: dict = {}
+        for p in participations:
+            path = "reshare" if p.tier_reshare is not None else "reveal"
+            counts[path] = counts.get(path, 0) + 1
+        for path, n in counts.items():
             telemetry.counter(
                 "sda_tier_promotions_total",
                 "partial-sum promotions accepted into parent-tier aggregations",
                 tier=str(agg.tiers),
+                path=path,
             ).inc(n)
 
     def _validate_recipient_encryption(self, participation, agg) -> None:
@@ -406,6 +547,9 @@ class SdaServer:
 
     def create_clerking_result(self, result) -> None:
         self.clerking_job_store.create_clerking_result(result)
+
+    def complete_clerking_job(self, clerk_id, job_id) -> None:
+        self.clerking_job_store.complete_clerking_job(clerk_id, job_id)
 
     def get_snapshot_result(self, aggregation_id, snapshot_id) -> Optional[SnapshotResult]:
         # The snapshot must exist AND belong to this aggregation — otherwise
@@ -651,3 +795,12 @@ class SdaServerService(SdaService):
             raise ServerError("Job not found")
         _acl_agent_is(caller, job.clerk)
         self.server.create_clerking_result(result)
+
+    def complete_clerking_job(self, caller, job_id) -> None:
+        # same ownership check as create_clerking_result: the job must
+        # exist and belong to the caller before it can be retired
+        job = self.server.get_clerking_job(caller.id, job_id)
+        if job is None:
+            raise ServerError("Job not found")
+        _acl_agent_is(caller, job.clerk)
+        self.server.complete_clerking_job(job.clerk, job_id)

@@ -820,6 +820,13 @@ class ShardedAggregationsStore(_ReplicatedPartitions, stores.AggregationsStore):
             self._router.targets(aggregation_id),
         )
 
+    def discard_participations(self, aggregation_id, participation_ids) -> None:
+        self._write(
+            "discard_participations",
+            (aggregation_id, list(participation_ids)),
+            self._router.targets(aggregation_id),
+        )
+
     # -- snapshots -----------------------------------------------------------
 
     def create_snapshot(self, snapshot) -> None:
@@ -1065,6 +1072,31 @@ class ShardedClerkingJobsStore(_ReplicatedPartitions, stores.ClerkingJobsStore):
         if targets is None:
             raise ServerError(f"unroutable clerking result: job {result.job}")
         self._write("create_clerking_result", (result,), targets)
+
+    def complete_clerking_job(self, clerk_id, job_id) -> None:
+        targets = self._router.job_targets(job_id)
+        if targets is None:
+            # same cold-map probe as create_clerking_result: the caller
+            # owns the job, and job ids are unique across partitions
+            for probe, part in self._live_parts():
+                self._router.touch(probe)
+                try:
+                    job = part.get_clerking_job(clerk_id, job_id)
+                except SdaError:
+                    raise
+                except Exception:
+                    if self._router.replicas == 1:
+                        raise
+                    continue
+                if job is not None:
+                    targets = self._router.targets(job.aggregation)
+                    if probe not in targets:
+                        targets = (probe,)
+                    self._router.note_job(job_id, targets)
+                    break
+        if targets is None:
+            raise ServerError(f"unroutable clerking job: {job_id}")
+        self._write("complete_clerking_job", (clerk_id, job_id), targets)
 
     # -- snapshot-scoped result reads ---------------------------------------
     # Every job of a snapshot lives on one replica set (its

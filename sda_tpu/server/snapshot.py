@@ -60,6 +60,84 @@ def snapshot_dag(aggregation) -> list:
 # -- pipeline stages ---------------------------------------------------------
 
 
+def _stage_prepare_reshare(server, aggregation, snapshot) -> None:
+    """Resolve share-promotion epochs BEFORE the membership freeze.
+
+    A tiered parent's participation table may hold, per derived child,
+    tier_reshare-tagged rows from several epochs (the full-committee
+    epoch 0, plus a survivor reissue after a clerk death) and one
+    mask-correction row. Only ONE consistent epoch per child may enter
+    the frozen cut — folding two epochs would double-count the
+    sub-cohort — so this stage picks, per child, the highest COMPLETE
+    epoch (one consistent survivor set, a column row from every survivor,
+    enough survivors to reconstruct) and discards every other tagged row
+    of that child. A child with no complete epoch (or a masked child
+    missing its correction row) contributes nothing: all its rows are
+    dropped and the round continues exact off the surviving subtrees —
+    the cross-tier threshold semantics client/tiers.py builds on.
+
+    Runs only on tiered nodes, and only while membership is still
+    unfrozen: once ``snapshot_participations`` has pinned a member list
+    (a crashed earlier run), the resolution that freeze saw must stand —
+    discarding a frozen member would corrupt the transpose count.
+    """
+    if not aggregation.is_tiered():
+        return
+    if (
+        server.aggregation_store.count_participations_snapshot(
+            snapshot.aggregation, snapshot.id
+        )
+        > 0
+    ):
+        return  # membership already frozen: resolution is pinned
+    by_child: dict = {}
+    for part in server.aggregation_store.iter_participations(snapshot.aggregation):
+        tag = part.tier_reshare
+        if tag is not None:
+            by_child.setdefault(tag.child, []).append(part)
+    needs_mask = aggregation.masking_scheme.has_mask()
+    threshold = aggregation.committee_sharing_scheme.reconstruction_threshold
+    discard = []
+    for child, rows in by_child.items():
+        mask_rows = [p for p in rows if p.tier_reshare.position is None]
+        epochs: dict = {}
+        for p in rows:
+            if p.tier_reshare.position is not None:
+                epochs.setdefault(p.tier_reshare.epoch, []).append(p)
+        chosen = None
+        for epoch in sorted(epochs, reverse=True):
+            cols = epochs[epoch]
+            survivor_sets = {tuple(p.tier_reshare.survivors) for p in cols}
+            if len(survivor_sets) != 1:
+                continue  # inconsistent weights: Lagrange columns disagree
+            survivors = set(next(iter(survivor_sets)))
+            positions = {p.tier_reshare.position for p in cols}
+            if positions != survivors or len(survivors) < threshold:
+                continue  # incomplete epoch: missing a survivor's column
+            chosen = epoch
+            break
+        if chosen is None or (needs_mask and not mask_rows):
+            discard.extend(p.id for p in rows)
+            log.warning(
+                "snapshot %s: child %s has no complete re-share epoch; "
+                "dropping its %d promotion rows (subtree excluded)",
+                snapshot.id,
+                child,
+                len(rows),
+            )
+            continue
+        discard.extend(
+            p.id
+            for p in rows
+            if p.tier_reshare.position is not None and p.tier_reshare.epoch != chosen
+        )
+    if discard:
+        with get_metrics().phase("snapshot.prepare_reshare"):
+            server.aggregation_store.discard_participations(
+                snapshot.aggregation, discard
+            )
+
+
 def _stage_freeze(server, aggregation, snapshot) -> None:
     """Freeze the participation set: the consistent cut every later stage
     (and every retry) reads. Write-once per (aggregation, snapshot)."""
@@ -152,6 +230,7 @@ def _stage_commit(server, aggregation, snapshot) -> None:
 #: the pipeline, in order; each stage is f(server, aggregation, snapshot).
 #: Every stage before the final commit is idempotent by construction.
 SNAPSHOT_STAGES = (
+    _stage_prepare_reshare,
     _stage_freeze,
     _stage_fanout_jobs,
     _stage_collect_masks,

@@ -512,6 +512,22 @@ class SqliteAggregationsStore(AggregationsStore):
                 yield Participation.from_json(json.loads(body))
             last = rows[-1][0]
 
+    def discard_participations(self, aggregation_id, participation_ids) -> None:
+        ids = [str(pid) for pid in participation_ids]
+        if not ids:
+            return
+        a = str(aggregation_id)
+        chunk = 500  # stay under SQLITE_MAX_VARIABLE_NUMBER (999 legacy)
+        with self.db.transaction() as conn:
+            for lo in range(0, len(ids), chunk):
+                part = ids[lo : lo + chunk]
+                marks = ",".join("?" * len(part))
+                conn.execute(
+                    f"DELETE FROM participations "
+                    f"WHERE aggregation = ? AND id IN ({marks})",
+                    [a] + part,
+                )
+
     def snapshot_participations(self, aggregation_id, snapshot_id) -> None:
         s = str(snapshot_id)
         with self.db.transaction() as conn:
@@ -912,6 +928,15 @@ class SqliteClerkingJobsStore(ClerkingJobsStore):
             conn.execute(
                 "UPDATE jobs SET done = 1 WHERE id = ?", (str(result.job),)
             )
+
+    def complete_clerking_job(self, clerk_id, job_id) -> None:
+        with self.db.transaction() as conn:
+            row = conn.execute(
+                "SELECT clerk FROM jobs WHERE id = ?", (str(job_id),)
+            ).fetchone()
+            if row is None or row[0] != str(clerk_id):
+                raise InvalidRequestError(f"no job {job_id}")
+            conn.execute("UPDATE jobs SET done = 1 WHERE id = ?", (str(job_id),))
 
     def list_results(self, snapshot_id) -> list:
         rows = self.db.query_all(

@@ -244,36 +244,61 @@ def _flat_round(tmp_path, service, sharing, values, tag="flat"):
     return recipient.reveal_aggregation(agg.id).positive()
 
 
-def _tiered_round(tmp_path, service, sharing, values, tiers, m, tag="tiered"):
+def _setup_tiered(
+    tmp_path, service, sharing, tiers, m, tag="tiered", promotion=None, disjoint=False
+):
     recipient = new_client(tmp_path / f"{tag}-r", service)
     recipient.upload_agent()
     rkey = recipient.new_encryption_key()
     recipient.upload_encryption_key(rkey)
     agg = _aggregation(sharing, tiers=tiers, m=m)
     agg.recipient, agg.recipient_key = recipient.agent.id, rkey
-    pool = _provision_pool(tmp_path / tag, service, sharing.output_size)
+    agg.tier_promotion = promotion
+    pool_size = sharing.output_size
+    if disjoint:
+        pool_size *= sum(m**t for t in range(tiers))
+    pool = _provision_pool(tmp_path / tag, service, pool_size)
 
     def new_promoter(name):
         return new_client(tmp_path / f"{tag}-{name}", service)
 
-    round = setup_tier_round(recipient, agg, new_promoter, pool)
+    return setup_tier_round(
+        recipient, agg, new_promoter, pool, disjoint_committees=disjoint
+    ), agg
+
+
+def _participate_all(tmp_path, service, agg, values, tag="tiered"):
     participants = []
     for i, v in enumerate(values):
         p = new_client(tmp_path / f"{tag}-p{i}", service)
         p.upload_agent()
         p.participate(v, agg.id)
         participants.append(p)
+    return participants
+
+
+def _tiered_round(
+    tmp_path, service, sharing, values, tiers, m, tag="tiered", promotion=None
+):
+    round, agg = _setup_tiered(
+        tmp_path, service, sharing, tiers, m, tag=tag, promotion=promotion
+    )
+    participants = _participate_all(tmp_path, service, agg, values, tag=tag)
     result = run_tier_round(round)
     assert result.skipped == []
     return agg, round, participants, result.output.positive()
 
 
-@pytest.mark.parametrize("m", [1, 2, 4])
+@pytest.mark.parametrize("m", [1, 2, 3, 4, 8])
 @pytest.mark.parametrize("scheme", sorted(SHARINGS))
 def test_tiered_reveal_matches_flat_bytes(scheme, m, tmp_path):
     """The exactness matrix: for every sharing scheme, the 2-tier round
     at fan-out m reveals byte-identically to the flat round over the same
-    values (m=1 is the flat control against the plain modular sum)."""
+    values (m=1 is the flat control against the plain modular sum).
+    Shamir-family cells ride share-promotion (the default); additive
+    cells ride reveal-promotion — both must be exact. m=8 over 5
+    participants leaves sub-cohorts EMPTY, covering the zero-column /
+    zero-correction promotion edge."""
     expected = np.array(
         [sum(v[d] for v in VALUES) % MODULUS for d in range(DIM)], dtype=np.int64
     )
@@ -373,8 +398,17 @@ def test_vanished_sub_cohort_survival(tmp_path):
         round = setup_tier_round(
             recipient, agg, lambda name: new_client(tmp_path / name, ctx.service), pool
         )
+        # keep adding participants until BOTH sub-cohorts are populated —
+        # the leaf assignment hashes random agent ids, so a fixed count
+        # can (rarely) land everyone in one cohort and void the test
         by_leaf: dict = {}
-        for i, v in enumerate(VALUES):
+        values = [list(v) for v in VALUES]
+        for i in range(32):
+            if len(by_leaf) == 2 and i >= len(VALUES):
+                break
+            v = values[i] if i < len(values) else [i % 7, 1, i % 5, 2]
+            if i >= len(values):
+                values.append(v)
             p = new_client(tmp_path / f"p{i}", ctx.service)
             p.upload_agent()
             p.participate(v, agg.id)
@@ -435,3 +469,164 @@ def test_tiered_round_over_sharded_store(tmp_path):
         tmp_path, service, SHARINGS["additive"](), VALUES, tiers=2, m=2
     )
     assert out.values.tobytes() == expected.tobytes()
+
+
+# -- share promotion ---------------------------------------------------------
+
+
+def _expected_sum(values):
+    return np.array(
+        [sum(v[d] for v in values) % MODULUS for d in range(DIM)], dtype=np.int64
+    )
+
+
+def test_explicit_reveal_promotion_matches_default_reshare(tmp_path):
+    """The A/B knob: pinning ``tier_promotion="reveal"`` on a Shamir root
+    runs the old reveal-and-resubmit climb and must still match the
+    share-promotion default byte-for-byte."""
+    with with_service() as ctx:
+        _, _, _, reshared = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["shamir"](), VALUES, tiers=2, m=2
+        )
+        _, _, _, revealed = _tiered_round(
+            tmp_path,
+            ctx.service,
+            SHARINGS["shamir"](),
+            VALUES,
+            tiers=2,
+            m=2,
+            tag="revealed",
+            promotion="reveal",
+        )
+        assert revealed.values.tobytes() == reshared.values.tobytes()
+        assert revealed.values.tobytes() == _expected_sum(VALUES).tobytes()
+
+
+def test_reshare_promotion_validation(tmp_path):
+    """Explicit share-promotion on an additive committee is rejected at
+    the door (no Lagrange structure to re-share through); bogus promotion
+    strings and flat records carrying the knob are rejected too."""
+    with with_service() as ctx:
+        recipient = new_client(tmp_path / "r", ctx.service)
+        recipient.upload_agent()
+        rkey = recipient.new_encryption_key()
+        recipient.upload_encryption_key(rkey)
+
+        def submit(sharing, tiers, m, promotion):
+            agg = _aggregation(sharing, tiers=tiers, m=m)
+            agg.recipient, agg.recipient_key = recipient.agent.id, rkey
+            agg.tier_promotion = promotion
+            recipient.upload_aggregation(agg)
+
+        with pytest.raises(InvalidRequestError):
+            submit(SHARINGS["additive"](), 2, 2, "reshare")
+        with pytest.raises(InvalidRequestError):
+            submit(SHARINGS["shamir"](), 2, 2, "promote-harder")
+        with pytest.raises(InvalidRequestError):
+            submit(SHARINGS["shamir"](), None, None, "reshare")  # flat
+        submit(SHARINGS["additive"](), 2, 2, "reveal")  # explicit old path
+        submit(SHARINGS["shamir"](), 2, 2, "reshare")  # explicit default
+
+
+def test_share_promotion_never_reconstructs_partials(tmp_path, monkeypatch):
+    """The honesty assertion: across a whole share-promoted round, secret
+    reconstruction happens EXACTLY once — the real recipient's root
+    reveal. No promoter-side or clerk-side code path ever reconstructs a
+    sub-cohort partial (the deviation the reveal path carried)."""
+    from sda_tpu.crypto import sharing as sharing_mod
+
+    calls = []
+    for cls in (
+        sharing_mod.AdditiveReconstructor,
+        sharing_mod.PackedShamirReconstructor,
+    ):
+        orig = cls.reconstruct
+
+        def counted(self, indexed_shares, _orig=orig):
+            calls.append(type(self).__name__)
+            return _orig(self, indexed_shares)
+
+        monkeypatch.setattr(cls, "reconstruct", counted)
+
+    with with_service() as ctx:
+        _, _, _, out = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["shamir"](), VALUES, tiers=2, m=2
+        )
+    assert out.values.tobytes() == _expected_sum(VALUES).tobytes()
+    assert calls == ["PackedShamirReconstructor"], calls
+
+
+def test_children_never_result_ready_under_reshare(tmp_path):
+    """Wire shape of the promoted rows: each sub-committee leaves
+    ``share_count`` tagged columns plus one mask-correction row in the
+    parent, and no child ever produces a clerking result (nothing exists
+    for a promoter to reveal)."""
+    sharing = SHARINGS["shamir"]()
+    with with_service() as ctx:
+        agg, round, _, out = _tiered_round(
+            tmp_path, ctx.service, sharing, VALUES, tiers=2, m=2
+        )
+        assert out.values.tobytes() == _expected_sum(VALUES).tobytes()
+        status = ctx.service.get_tier_status(round.recipient.agent, agg.id)
+        root = next(n for n in status.nodes if n.tier == 0)
+        children = [n for n in status.nodes if n.tier == 1]
+        assert root.number_of_participations == len(children) * (
+            sharing.output_size + 1
+        )
+        assert root.result_ready
+        assert all(not n.result_ready for n in children)
+
+
+def test_clerk_death_epoch1_reissue_exact(tmp_path):
+    """Cross-tier threshold survival: kill one leaf clerk AFTER ingest
+    but before the drain — its job is never processed, so the child's
+    epoch-0 promotion stays incomplete. The survivors re-issue their
+    cached columns over the reduced survivor set (epoch 1), the parent's
+    prepare stage keeps that epoch, and the STRICT round still reveals
+    the exact flat sum — the dropout upgrade reveal-promotion never had."""
+    sharing = BasicShamirSharing(
+        share_count=3, privacy_threshold=1, prime_modulus=MODULUS
+    )
+    with with_service() as ctx:
+        round, agg = _setup_tiered(
+            tmp_path, ctx.service, sharing, tiers=2, m=2, disjoint=True
+        )
+        _participate_all(tmp_path, ctx.service, agg, VALUES)
+        victim_node = round.nodes[1]
+        assert victim_node.node.parent == agg.id
+        victim_node.clerks = victim_node.clerks[1:]  # never drained again
+        result = run_tier_round(round, strict=True)
+        assert result.skipped == []
+        assert (
+            result.output.positive().values.tobytes()
+            == _expected_sum(VALUES).tobytes()
+        )
+
+
+def test_clerk_death_below_threshold_skips_subtree(tmp_path):
+    """Below-threshold death is still a clean skip: with only one of
+    three clerks left (threshold 2) the child cannot re-share; under
+    ``strict=False`` its whole subtree is dropped and the root reveals
+    the exact sum of the OTHER sub-cohort's participants."""
+    sharing = BasicShamirSharing(
+        share_count=3, privacy_threshold=1, prime_modulus=MODULUS
+    )
+    with with_service() as ctx:
+        round, agg = _setup_tiered(
+            tmp_path, ctx.service, sharing, tiers=2, m=2, disjoint=True
+        )
+        participants = _participate_all(tmp_path, ctx.service, agg, VALUES)
+        victim_node = round.nodes[1]
+        victim_node.clerks = victim_node.clerks[:1]
+        result = run_tier_round(round, strict=False)
+        assert result.skipped == [victim_node.aggregation.id]
+        survivors = [
+            v
+            for p, v in zip(participants, VALUES)
+            if tiers_mod.leaf_aggregation_id(agg, p.agent.id)
+            != victim_node.aggregation.id
+        ]
+        assert (
+            result.output.positive().values.tobytes()
+            == _expected_sum(survivors).tobytes()
+        )
