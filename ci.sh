@@ -121,15 +121,25 @@ assert d["topology"]["frontend_processes"] >= 2, d["topology"]
 assert d["topology"]["shards"] >= 2 and d["topology"]["replicas"] >= 2
 assert d["certified_max_cohort"] >= 4, \
     f"smoke ladder certified nothing: {d['certified_max_cohort']}"
-assert all(r["exact"] and r["flat_byte_match"]
-           for r in d["ladder"] if r.get("certified")), \
-    "a certified rung was not byte-identical to the flat baseline"
+assert all(r["exact"] and r["flat_byte_match"] for r in d["ladder"]), \
+    "a ladder rung was not byte-identical to the flat baseline"
+# the arrival-pipelined ingest must actually be the path the smoke ran:
+# the artifact records the knob, every ladder rung must have taken it,
+# and the within-run serial-vs-pipelined arrivals ratio must be banked
+assert d.get("ingest_pipeline") is True, \
+    f"flagship smoke did not run the pipelined ingest: {d.get('ingest_pipeline')}"
+assert all(r.get("ingest_pipeline") for r in d["ladder"]), \
+    "a ladder rung fell back to the serial arrivals loop"
+ab = d.get("arrivals_ab") or {}
+assert isinstance(ab.get("arrivals_pipeline_speedup"), (int, float)), \
+    f"no arrivals A/B ratio banked: {ab}"
 merged = d.get("merged_samples") or []
 assert merged, "no merged cross-process telemetry series banked"
 peak = max(s.get("procs", 0) for s in merged)
 assert peak >= 2, f"merged series never saw both frontends (peak {peak})"
 print(f"ci: flagship certified cohort {d['certified_max_cohort']} "
-      f"({len(merged)} merged buckets, peak {peak} procs)")
+      f"({len(merged)} merged buckets, peak {peak} procs, "
+      f"arrivals speedup {ab['arrivals_pipeline_speedup']}x)")
 EOF
 rm -rf "$FLAG_ART"
 

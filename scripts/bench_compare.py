@@ -159,8 +159,18 @@ def _metrics_flagship(d: dict) -> dict:
     (``tier_path``; artifacts that predate the field ran the reveal
     path) so a path switch — which also switches the committee scheme
     and its per-job crypto cost — never pairs rates across schemes;
-    ``certified_max_cohort`` stays comparable across every campaign."""
+    ``certified_max_cohort`` stays comparable across every campaign.
+
+    ``arrivals_pipeline_speedup`` is the within-run ingest A/B: the
+    serial leg's ``rung.arrivals`` seconds over the pipelined leg's at
+    the same cohort, both rungs interleaved on the same host — like
+    ``promote_reshare_speedup``, the ratio is drift-invariant and
+    regresses exactly when the arrival pipeline stops beating the
+    per-phone loop."""
     out = {}
+    ab = d.get("arrivals_ab") if isinstance(d.get("arrivals_ab"), dict) else {}
+    if isinstance(ab.get("arrivals_pipeline_speedup"), (int, float)):
+        out["arrivals_pipeline_speedup"] = float(ab["arrivals_pipeline_speedup"])
     if isinstance(d.get("certified_max_cohort"), (int, float)) \
             and d["certified_max_cohort"] > 0:
         out["certified_max_cohort"] = float(d["certified_max_cohort"])

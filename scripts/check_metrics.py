@@ -91,6 +91,13 @@ REQUIRED_SERIES = [
     # workload plane: drive_sketch_round completes one count-min round
     # through SketchQuery, which ticks the per-family round counter
     "sda_workload_rounds_total",
+    # arrival-pipelined ingest: drive_ingest_pipeline runs one traced
+    # cohort through client/ingest.py over live REST, so all three
+    # pipeline series must show — per-stage latency (plan/build/upload),
+    # per-row release lag, and the built-but-unreleased backlog gauge
+    "sda_ingest_stage_seconds",
+    "sda_arrival_lag_seconds",
+    "sda_ingest_backlog",
 ]
 
 
@@ -305,6 +312,74 @@ def drive_sketch_round(base_url: str, tmp: str) -> None:
         "sketch workload sum disagrees"
 
 
+def drive_ingest_pipeline(base_url: str, tmp: str) -> None:
+    """One arrival-pipelined cohort over the live REST stack
+    (client/ingest.py): a deterministic trace with churn paces a
+    12-phone cohort through plan/build/upload, so the scrape must carry
+    sda_ingest_stage_seconds{stage=plan|build|upload},
+    sda_arrival_lag_seconds, and the sda_ingest_backlog gauge — and the
+    reveal over the pipelined rows must stay exact."""
+    from sda_tpu.client import SdaClient, ingest_cohort
+    from sda_tpu.crypto import Keystore
+    from sda_tpu.protocol import (
+        AdditiveSharing,
+        Aggregation,
+        AggregationId,
+        ChaChaMasking,
+        SodiumEncryptionScheme,
+    )
+    from sda_tpu.rest import SdaHttpClient, TokenStore
+    from sda_tpu.utils.arrivals import ArrivalTrace
+
+    def new_client(subdir):
+        keystore = Keystore(os.path.join(tmp, subdir))
+        service = SdaHttpClient(base_url, TokenStore(os.path.join(tmp, subdir)))
+        return SdaClient(SdaClient.new_agent(keystore), keystore, service)
+
+    recipient = new_client("ip-recipient")
+    rkey = recipient.new_encryption_key()
+    recipient.upload_agent()
+    recipient.upload_encryption_key(rkey)
+    agg = Aggregation(
+        id=AggregationId.random(),
+        title="check-metrics-ingest-pipeline",
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=ChaChaMasking(modulus=433, dimension=4, seed_bitsize=128),
+        committee_sharing_scheme=AdditiveSharing(share_count=2, modulus=433),
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+    recipient.upload_aggregation(agg)
+    clerks = [new_client(f"ip-clerk{i}") for i in range(2)]
+    for clerk in clerks:
+        clerk.upload_agent()
+        clerk.upload_encryption_key(clerk.new_encryption_key())
+    recipient.begin_aggregation(agg.id, chosen_clerks=[c.agent.id for c in clerks])
+
+    phones = [new_client(f"ip-phone{i}") for i in range(2)]
+    for p in phones:
+        p.upload_agent()
+    values = [[i % 7, i % 5, 1, i % 3] for i in range(12)]
+    import time as _time
+
+    trace = ArrivalTrace.from_text("base=400,churn=0.3:11")
+    cursor = {"index": 0, "t": 0.0, "t0": _time.perf_counter()}
+    report = ingest_cohort(
+        phones, values, agg.id, trace=trace, cursor=cursor, window=4
+    )
+    assert report.rows == 12, "pipelined ingest lost rows"
+    recipient.end_aggregation(agg.id)
+    for clerk in clerks:
+        clerk.run_chores(-1)
+    recipient.run_chores(-1)
+    out = recipient.reveal_aggregation(agg.id).positive()
+    expected = [sum(v[d] for v in values) % 433 for d in range(4)]
+    assert list(out.values) == expected, "pipelined ingest reveal disagrees"
+
+
 def drive_faulted_leg(base_url: str, tmp: str) -> None:
     """Rerun the round workload under fault injection so the scrape must
     contain the churn plane's series: sda_fault_injections_total (the
@@ -481,6 +556,7 @@ def main() -> int:
         with telemetry.trace("ci-check-metrics"):
             drive_workload(base_url, tmp)
         drive_tier_round(base_url, tmp)
+        drive_ingest_pipeline(base_url, tmp)
         drive_faulted_leg(base_url, tmp)
         drive_engine()
         observability_errors = check_observability_routes(base_url)

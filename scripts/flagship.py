@@ -251,13 +251,17 @@ def tiered_aggregation(recipient, rkey, tiers: int, m: int, tag: str):
     )
 
 
-def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
+def run_rung(rung: int, cohort: int, ctx: dict, pipeline=None) -> dict:
     """One ladder rung: provision a fresh tiered tree over the live
     plane, pace the cohort in on the arrival trace, run the round with
     EXTERNAL committees (the daemons), reveal, and hold the reveal
-    byte-identical to the flat baseline over the same values."""
+    byte-identical to the flat baseline over the same values.
+
+    ``pipeline`` overrides the campaign's ingest path for this rung
+    (the arrivals A/B legs pin one serial and one pipelined rung at the
+    same cohort); None inherits ``ctx["pipeline"]``."""
     from sda_tpu import telemetry
-    from sda_tpu.client import run_tier_round, setup_tier_round
+    from sda_tpu.client import ingest_cohort, run_tier_round, setup_tier_round
 
     t0 = time.perf_counter()
     tmp, roots = ctx["tmp"], ctx["roots"]
@@ -291,24 +295,45 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
     values = rung_values(rung, cohort, ctx["workload"])
     # the cohort arrives on the trace: each upload waits for its arrival
     # time; churned phones disconnect and retry at the end of the round
-    deferred = []
+    pipelined = ctx["pipeline"] if pipeline is None else pipeline
     participants = ctx["participants"]
-    with telemetry.span("rung.arrivals", rung=rung, cohort=cohort):
-        for i, v in enumerate(values):
-            k = cursor["index"]
-            cursor["index"] = k + 1
-            cursor["t"] = trace.next_arrival(k, cursor["t"])
-            delay = cursor["t0"] + cursor["t"] - time.perf_counter()
-            if delay > 0:
-                time.sleep(delay)
-            p = participants[i % len(participants)]
-            part = p.new_participations([v], agg.id)[0]
-            if trace.is_churned(k):
-                deferred.append((p, part))
-                continue
-            p.service.create_participation(p.agent, part)
-        for p, part in deferred:
-            p.service.create_participation(p.agent, part)
+    churned = 0
+    with telemetry.span("rung.arrivals", rung=rung, cohort=cohort,
+                        pipelined=pipelined):
+        if pipelined:
+            # plan the whole schedule up front, build windows of phones
+            # ahead of their arrival times, release per-frontend
+            # micro-batches on the bulk route (client/ingest.py)
+            report = ingest_cohort(
+                participants, values, agg.id, trace=trace, cursor=cursor
+            )
+            churned = report.churned
+        else:
+            # legacy serial baseline (SDA_INGEST_PIPELINE=0 / A/B leg):
+            # per-phone batch-of-1 build + single POST at arrival time
+            deferred = []
+            for i, v in enumerate(values):
+                k = cursor["index"]
+                cursor["index"] = k + 1
+                cursor["t"] = trace.next_arrival(k, cursor["t"])
+                delay = cursor["t0"] + cursor["t"] - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                p = participants[i % len(participants)]
+                part = p.new_participations([v], agg.id)[0]
+                if trace.is_churned(k):
+                    deferred.append((p, part))
+                    continue
+                p.service.create_participation(p.agent, part)
+            # the churn drain reconnects in bulk: one batch POST per
+            # participant (= per frontend under tier placement), not one
+            # create_participation round-trip per phone
+            by_phone: dict = {}
+            for p, part in deferred:
+                by_phone.setdefault(id(p), (p, []))[1].append(part)
+            for p, parts in by_phone.values():
+                p.upload_participations(parts)
+            churned = len(deferred)
 
     with telemetry.span("rung.round", rung=rung, cohort=cohort):
         result = run_tier_round(
@@ -333,7 +358,8 @@ def run_rung(rung: int, cohort: int, ctx: dict) -> dict:
     r = {
         "rung": rung,
         "cohort": cohort,
-        "churned": len(deferred),
+        "churned": churned,
+        "ingest_pipeline": pipelined,
         "committees": len(tround.nodes),
         "round_s": round(elapsed, 2),
         "exact": exact,
@@ -457,6 +483,7 @@ def main() -> int:
     os.environ.setdefault("SDA_TELEMETRY", "1")
     del env_ts
 
+    from sda_tpu.client.ingest import pipeline_enabled
     from sda_tpu.utils.arrivals import ArrivalTrace
 
     global DIM
@@ -481,6 +508,7 @@ def main() -> int:
         "tier_path": "reshare",
         "trace": args.trace,
         "simulated_population": args.simulated_population,
+        "ingest_pipeline": pipeline_enabled(),
     }
     with tempfile.TemporaryDirectory() as td:
         tmp = pathlib.Path(td)
@@ -530,6 +558,7 @@ def main() -> int:
                 "pool": pool, "participants": participants,
                 "tiers": args.tiers, "fanout": args.fanout,
                 "workload": args.workload,
+                "pipeline": pipeline_enabled(),
                 "trace": ArrivalTrace.from_text(args.trace),
                 "cursor": {"index": 0, "t": 0.0, "t0": time.perf_counter()},
                 "poll_timeout": max(60.0, args.rung_deadline),
@@ -570,6 +599,43 @@ def main() -> int:
             # SpanLog shape scripts/trace_report.py consumes
             record["spans"] = last_spans
             record["certified_max_cohort"] = certified
+
+            # within-run arrivals A/B at the deepest certified cohort:
+            # one serial rung, one pipelined rung, back to back on the
+            # SAME live plane — their rung.arrivals ratio is the
+            # drift-immune speedup bench_compare gates (host load moves
+            # both legs together; the ratio regresses only when the
+            # pipeline stops beating the per-phone loop)
+            ab_cohort = certified if certified else args.cohort_start
+            legs: dict = {}
+            for ab_ix, (leg, pipe) in enumerate(
+                [("serial", False), ("pipelined", True)]
+            ):
+                ab = run_rung(rung + 1 + ab_ix, ab_cohort, ctx, pipeline=pipe)
+                ab.pop("_elapsed")
+                ab.pop("_spans")
+                assert ab["exact"] and ab["flat_byte_match"], (
+                    f"arrivals A/B {leg} leg lost exactness"
+                )
+                legs[leg] = {
+                    "arrivals_s": ab["stages"].get("rung.arrivals"),
+                    "round_s": ab["round_s"],
+                    "churned": ab["churned"],
+                    "exact": ab["exact"],
+                    "flat_byte_match": ab["flat_byte_match"],
+                }
+                print(f"[flagship] arrivals A/B {leg}: cohort {ab_cohort} "
+                      f"arrivals={legs[leg]['arrivals_s']}s", file=sys.stderr)
+            serial_s = legs["serial"]["arrivals_s"]
+            pipe_s = legs["pipelined"]["arrivals_s"]
+            record["arrivals_ab"] = {
+                "cohort": ab_cohort,
+                "legs": legs,
+                "arrivals_pipeline_speedup": (
+                    round(serial_s / pipe_s, 4)
+                    if serial_s and pipe_s else None
+                ),
+            }
             record["scale_factor"] = (
                 round(args.simulated_population / certified, 1)
                 if certified else None
@@ -603,7 +669,9 @@ def main() -> int:
           f"{record['topology']['shards']} shards (R="
           f"{record['topology']['replicas']}), "
           f"{record['fleet_timeseries']['merged_buckets']} merged buckets "
-          f"(max {record['fleet_timeseries']['max_procs_in_bucket']} procs) "
+          f"(max {record['fleet_timeseries']['max_procs_in_bucket']} procs), "
+          f"arrivals_pipeline_speedup="
+          f"{record['arrivals_ab']['arrivals_pipeline_speedup']} "
           f"in {record['campaign_s']}s", file=sys.stderr)
     print(path)
 
@@ -611,6 +679,7 @@ def main() -> int:
         record["certified_max_cohort"] >= args.cohort_start
         and record["fleet_timeseries"]["merged_buckets"] >= 1
         and record["fleet_timeseries"]["max_procs_in_bucket"] >= 2
+        and record["arrivals_ab"]["arrivals_pipeline_speedup"] is not None
     )
     return 0 if ok else 1
 

@@ -190,6 +190,7 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
     import concurrent.futures
 
     from sda_tpu import telemetry
+    from sda_tpu.client.ingest import ingest_cohort, pipeline_enabled
 
     recipient, rkey, clerks, participant = stack
     values = workload_values(ix, round_size, workload)
@@ -206,10 +207,21 @@ def run_round(ix: int, stack, round_size: int, rate: float | None,
             if kill_router is not None:
                 victim = kill_router.targets(agg.id)[0]
                 kill_router.wedge(victim)
-            with telemetry.span("ingest.build", rows=round_size):
-                parts = participant.new_participations(values, agg.id)
+            # trace rounds ride the arrival pipeline (plan/build/upload
+            # inside ingest_cohort), so they skip the upfront build;
+            # SDA_INGEST_PIPELINE=0 pins the legacy paced-singles path
+            pipelined_trace = trace_ctx is not None and pipeline_enabled()
+            if not pipelined_trace:
+                with telemetry.span("ingest.build", rows=round_size):
+                    parts = participant.new_participations(values, agg.id)
             t0 = time.perf_counter()
-            if submit_services:
+            if pipelined_trace:
+                report = ingest_cohort(
+                    [participant], values, agg.id,
+                    trace=trace_ctx["trace"], cursor=trace_ctx,
+                )
+                churned = report.churned
+            elif submit_services:
                 # concurrent burst: each worker drains its slice flat-out
                 # on its own client; 429s surface as client-side paced
                 # retries (sda_rest_retries_total), sheds tick

@@ -61,6 +61,9 @@ campaign with the process/shard/replica topology, the certified-max-
 cohort headline and its implied scale factor against the simulated
 population, rungs certified vs attempted, the peak certified
 phones-per-second, and the merged cross-process telemetry coverage.
+Flagship artifacts carrying the within-run arrivals A/B leg get a second
+table: the serial vs pipelined ``rung.arrivals`` walls at the same
+cohort, side by side with the gated speedup ratio.
 
 Also tabulates the sketch-accuracy rider artifacts
 (``bench-artifacts/sketch-<stamp>.json``, written by bench.py's
@@ -654,6 +657,65 @@ def print_flagship(rows) -> None:
         )
 
 
+def load_arrivals_ab(artdir: pathlib.Path):
+    """One row per flagship-*.json campaign carrying the within-run
+    arrivals A/B (scripts/flagship.py): the serial and pipelined
+    rung.arrivals walls at the same cohort on the same live plane, the
+    drift-immune speedup ratio bench_compare gates, and both legs'
+    exactness flags."""
+    rows = []
+    for f in sorted(artdir.glob("flagship-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        ab = d.get("arrivals_ab") if isinstance(d, dict) else None
+        if not isinstance(ab, dict):
+            continue
+        legs = ab.get("legs") if isinstance(ab.get("legs"), dict) else {}
+        serial = legs.get("serial") if isinstance(legs.get("serial"), dict) else {}
+        pipe = (
+            legs.get("pipelined")
+            if isinstance(legs.get("pipelined"), dict) else {}
+        )
+        rows.append(
+            {
+                "artifact": f.name,
+                "cohort": ab.get("cohort"),
+                "serial_s": serial.get("arrivals_s"),
+                "pipelined_s": pipe.get("arrivals_s"),
+                "speedup": ab.get("arrivals_pipeline_speedup"),
+                "churned": (serial.get("churned"), pipe.get("churned")),
+                "exact": (
+                    serial.get("exact") and serial.get("flat_byte_match")
+                    and pipe.get("exact") and pipe.get("flat_byte_match")
+                ),
+            }
+        )
+    return rows
+
+
+def print_arrivals_ab(rows) -> None:
+    print("\narrivals ingest A/B (serial vs pipelined, flagship-*.json):")
+    print(
+        f"{'cohort':>7} {'serial_s':>9} {'pipe_s':>8} {'speedup':>8} "
+        f"{'churned':>9} {'exact':>5}  artifact"
+    )
+    for r in rows:
+        churned = (
+            f"{r['churned'][0]}/{r['churned'][1]}"
+            if None not in r["churned"] else "-"
+        )
+        exact = "-" if r["exact"] is None else ("yes" if r["exact"] else "NO")
+        print(
+            f"{r['cohort'] if r['cohort'] is not None else '-':>7} "
+            f"{r['serial_s'] if r['serial_s'] is not None else '-':>9} "
+            f"{r['pipelined_s'] if r['pipelined_s'] is not None else '-':>8} "
+            f"{r['speedup'] if r['speedup'] is not None else '-':>8} "
+            f"{churned:>9} {exact:>5}  {r['artifact']}"
+        )
+
+
 def load_sketch(artdir: pathlib.Path):
     """One row per sketch family per wire dimension per sketch-*.json
     artifact (bench.py's measure_sketch_accuracy): the accuracy-vs-
@@ -817,6 +879,7 @@ def main() -> int:
     promotion_rows = load_promotion_ab(artdir)
     soak_rows = load_soak(artdir)
     flagship_rows = load_flagship(artdir)
+    arrivals_rows = load_arrivals_ab(artdir)
     sketch_rows = load_sketch(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
@@ -889,6 +952,8 @@ def main() -> int:
         print_soak(soak_rows)
     if flagship_rows:
         print_flagship(flagship_rows)
+    if arrivals_rows:
+        print_arrivals_ab(arrivals_rows)
     if sketch_rows:
         print_sketch(sketch_rows)
     if scenario_cells:

@@ -11,6 +11,7 @@ from ..crypto import CryptoModule, Keystore
 from ..protocol import Agent, AgentId, SdaService
 from .clerk import Clerking
 from .committee import run_committee
+from .ingest import IngestReport, ingest_cohort, plan_arrivals
 from .participate import Participating
 from .profile import Maintenance
 from .receive import Receiving, RecipientOutput
@@ -48,6 +49,9 @@ __all__ = [
     "Maintenance",
     "RecipientOutput",
     "run_committee",
+    "IngestReport",
+    "ingest_cohort",
+    "plan_arrivals",
     "TierRound",
     "TierRoundNode",
     "TierRoundResult",

@@ -125,17 +125,38 @@ class Participating(VerifiedKeys):
         route: bool = True,
         ids=None,
         tier_reshare=None,
+        cache=None,
     ) -> list:
         """``ids`` pins client-chosen participation ids (share-promotion
         rows use deterministic uuid5 ids so re-drains collide idempotently
         instead of double-counting); ``tier_reshare`` tags every built row
         as a tier promotion (protocol.resources.TierReshare). Both default
-        off, leaving ordinary participations byte-unchanged."""
+        off, leaving ordinary participations byte-unchanged.
+
+        ``cache`` (a caller-owned dict) memoizes the aggregation record,
+        leaf resolution, and committee across repeated calls against the
+        same round — the windowed ingest pipeline builds many small
+        batches per phone, and without it every window re-pays the same
+        service round-trips. Scope a cache to one round: it never
+        observes committee changes made after the first fetch."""
         secrets_rows = [np.asarray(v, dtype=np.int64) for v in values_list]
         if ids is not None and len(ids) != len(secrets_rows):
             raise ValueError("ids must match values_list one to one")
 
-        aggregation = self.service.get_aggregation(self.agent, aggregation_id)
+        def cached(kind, key, fetch):
+            if cache is None:
+                return fetch()
+            value = cache.get((kind, key))
+            if value is None:
+                value = fetch()
+                if value is not None:
+                    cache[(kind, key)] = value
+            return value
+
+        aggregation = cached(
+            "aggregation", aggregation_id,
+            lambda: self.service.get_aggregation(self.agent, aggregation_id),
+        )
         if aggregation is None:
             raise ValueError("Could not find aggregation")
         if route and aggregation.is_tiered():
@@ -145,7 +166,10 @@ class Participating(VerifiedKeys):
             # round-trips. Only tier promoters pass route=False to hit a
             # tiered node directly (client/tiers.py).
             leaf_id = tiers_mod.leaf_aggregation_id(aggregation, self.agent.id)
-            aggregation = self.service.get_aggregation(self.agent, leaf_id)
+            aggregation = cached(
+                "aggregation", leaf_id,
+                lambda: self.service.get_aggregation(self.agent, leaf_id),
+            )
             if aggregation is None:
                 raise ValueError(
                     "tiered aggregation's sub-committees are not provisioned yet "
@@ -155,7 +179,10 @@ class Participating(VerifiedKeys):
             if len(secrets) != aggregation.vector_dimension:
                 raise ValueError("The input length does not match the aggregation.")
 
-        committee = self.service.get_committee(self.agent, aggregation.id)
+        committee = cached(
+            "committee", aggregation.id,
+            lambda: self.service.get_committee(self.agent, aggregation.id),
+        )
         if committee is None:
             raise ValueError("Could not find committee")
 

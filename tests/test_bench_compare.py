@@ -156,6 +156,34 @@ def test_flagship_certified_cohort_drop_fails(tmp_path, capsys):
     assert "certified_max_cohort" in out and "peak_cohort_per_s" in out
 
 
+def test_flagship_arrivals_speedup_drop_fails(tmp_path, capsys):
+    """The within-run serial-vs-pipelined arrivals ratio is drift-immune
+    (both legs share the run's host load), so a drop means the pipeline
+    genuinely stopped beating the per-phone loop — gated like any other
+    flagship metric."""
+    ladder = [{"rung": 0, "cohort": 512, "round_s": 9.0, "certified": True}]
+    ab = lambda speedup: {
+        "cohort": 512,
+        "legs": {"serial": {"arrivals_s": 14.6},
+                 "pipelined": {"arrivals_s": 14.6 / speedup}},
+        "arrivals_pipeline_speedup": speedup,
+    }
+    _write(tmp_path, "flagship-20260801-010000.json",
+           {"kind": "flagship", "certified_max_cohort": 512,
+            "ladder": ladder, "arrivals_ab": ab(2.8)})
+    _write(tmp_path, "flagship-20260805-010000.json",
+           {"kind": "flagship", "certified_max_cohort": 512,
+            "ladder": ladder, "arrivals_ab": ab(1.1)})  # -61%
+    assert _run(tmp_path) == 1
+    out = capsys.readouterr().out
+    assert "arrivals_pipeline_speedup" in out
+    # a steady ratio passes; an A/B-less older artifact is not a baseline
+    _write(tmp_path, "flagship-20260806-010000.json",
+           {"kind": "flagship", "certified_max_cohort": 512,
+            "ladder": ladder, "arrivals_ab": ab(1.12)})
+    assert _run(tmp_path) == 0
+
+
 def test_sketch_headroom_drop_fails(tmp_path, capsys):
     """sketch-* gates accuracy, not just throughput: data and seeds are
     pinned, so a bound_headroom collapse means the estimator changed —

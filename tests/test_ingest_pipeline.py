@@ -1,0 +1,327 @@
+"""Arrival-pipelined cohort ingest (sda_tpu/client/ingest.py).
+
+Four contracts, each against the real service surface:
+
+1. **Equivalence** — a pipelined cohort reveals byte-identically to the
+   legacy serial loop (build-at-arrival, one POST per phone) on the same
+   deterministic trace, across {additive, packed Shamir} x {mem, sqlite}
+   x {in-process, REST}.
+2. **Trace fidelity** — no row is handed to the service before its
+   planned arrival time minus the release slack, and churned rows upload
+   only after every live row (the serial path's deferred-churn shape).
+3. **Fault storm** — a mid-upload 15% drop/e503 mix drains exactly via
+   the REST retry plane: every row lands once, the reveal stays exact.
+4. **Backpressure** — under a bursty trace the built-but-unreleased
+   backlog never exceeds the configured bound, so build-ahead cannot
+   grow RSS with the cohort.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from sda_fixtures import new_client, new_committee_setup, with_service
+from sda_tpu.client.ingest import (
+    arrival_slack_s,
+    ingest_cohort,
+    pipeline_enabled,
+    plan_arrivals,
+)
+from sda_tpu.protocol import (
+    AdditiveSharing,
+    Aggregation,
+    AggregationId,
+    NoMasking,
+    PackedShamirSharing,
+    SodiumEncryptionScheme,
+)
+from sda_tpu.utils.arrivals import ArrivalTrace
+
+SCHEMES = {
+    "additive": lambda: AdditiveSharing(share_count=3, modulus=433),
+    "packed": lambda: PackedShamirSharing(
+        secret_count=3,
+        share_count=8,
+        privacy_threshold=4,
+        prime_modulus=433,
+        omega_secrets=354,
+        omega_shares=150,
+    ),
+}
+
+# the full scheme x store x binding cross the batch route must keep
+# equivalent under the pipeline
+MATRIX = [
+    (scheme, store, http)
+    for scheme in ("additive", "packed")
+    for store in ("mem", "sqlite")
+    for http in (False, True)
+]
+
+
+def _configure(monkeypatch, store: str, http: bool) -> None:
+    if store == "mem":
+        monkeypatch.delenv("SDA_TEST_STORE", raising=False)
+    else:
+        monkeypatch.setenv("SDA_TEST_STORE", store)
+    monkeypatch.setenv("SDA_TEST_HTTP", "1" if http else "0")
+
+
+def _new_aggregation(recipient, rkey, scheme, title) -> Aggregation:
+    return Aggregation(
+        id=AggregationId.random(),
+        title=title,
+        vector_dimension=4,
+        modulus=433,
+        recipient=recipient.agent.id,
+        recipient_key=rkey,
+        masking_scheme=NoMasking(),
+        committee_sharing_scheme=scheme,
+        recipient_encryption_scheme=SodiumEncryptionScheme(),
+        committee_encryption_scheme=SodiumEncryptionScheme(),
+    )
+
+
+def _reveal(recipient, clerks, agg):
+    recipient.end_aggregation(agg.id)
+    for clerk in clerks:
+        clerk.run_chores(-1)
+    recipient.run_chores(-1)
+    return np.asarray(recipient.reveal_aggregation(agg.id).positive().values)
+
+
+def _serial_leg(phones, values, agg, trace, cursor):
+    """The legacy flagship arrivals loop: sleep to each arrival, build a
+    batch-of-1, POST it alone; churned phones deferred to round end."""
+    deferred = []
+    for i, v in enumerate(values):
+        k = cursor["index"]
+        cursor["index"] = k + 1
+        cursor["t"] = trace.next_arrival(k, cursor["t"])
+        delay = cursor["t0"] + cursor["t"] - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        p = phones[i % len(phones)]
+        part = p.new_participations([v], agg.id)[0]
+        if trace.is_churned(k):
+            deferred.append((p, part))
+            continue
+        p.upload_participation(part)
+    for p, part in deferred:
+        p.upload_participation(part)
+    return len(deferred)
+
+
+@pytest.mark.parametrize("scheme_name,store,http", MATRIX)
+def test_pipelined_equals_serial(tmp_path, monkeypatch, scheme_name, store, http):
+    """Same trace, same values: the pipelined round's reveal must be
+    byte-identical to the serial round's (and both to the plaintext
+    sum), with the same churn count."""
+    _configure(monkeypatch, store, http)
+    scheme = SCHEMES[scheme_name]()
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=scheme.output_size
+        )
+        phones = [new_client(tmp_path / f"p{i}", ctx.service) for i in range(3)]
+        for p in phones:
+            p.upload_agent()
+        values = [[i % 7, (i + 1) % 5, 1, i % 3] for i in range(12)]
+        # a fast trace: the schedule is exercised, the sleeps are tiny
+        trace = ArrivalTrace.from_text("base=400,churn=0.25:13")
+
+        outs, churns = [], []
+        for leg in ("serial", "pipelined"):
+            agg = _new_aggregation(recipient, rkey, scheme, f"ingest-{leg}")
+            recipient.upload_aggregation(agg)
+            recipient.begin_aggregation(
+                agg.id, chosen_clerks=[c.agent.id for c in clerks]
+            )
+            cursor = {"index": 0, "t": 0.0, "t0": time.perf_counter()}
+            if leg == "serial":
+                churns.append(_serial_leg(phones, values, agg, trace, cursor))
+            else:
+                report = ingest_cohort(
+                    phones, values, agg.id, trace=trace, cursor=cursor, window=4
+                )
+                assert report.rows == len(values)
+                churns.append(report.churned)
+            outs.append(_reveal(recipient, clerks, agg))
+
+        assert churns[0] == churns[1] > 0, "legs disagree on the churn set"
+        assert outs[0].tobytes() == outs[1].tobytes(), \
+            "pipelined reveal is not byte-identical to serial"
+        expected = [sum(v[d] for v in values) % 433 for d in range(4)]
+        np.testing.assert_array_equal(outs[1], expected)
+
+
+def test_trace_fidelity(tmp_path, monkeypatch):
+    """Release discipline: every live row reaches the service no earlier
+    than its arrival time minus the slack, batches are churn-homogeneous,
+    and every churned row uploads after every live row."""
+    _configure(monkeypatch, "mem", False)
+    slack = 0.02
+    n, window = 20, 4
+    trace = ArrivalTrace.from_text("base=40,churn=0.2:5")
+    # the pure schedule, recomputed independently of the pipeline
+    schedule = plan_arrivals(trace, {"index": 0, "t": 0.0}, n)
+    assert any(e.churned for e in schedule) and any(not e.churned for e in schedule)
+
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=3
+        )
+        agg = _new_aggregation(
+            recipient, rkey, AdditiveSharing(share_count=3, modulus=433), "fidelity"
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        phones = [new_client(tmp_path / f"p{i}", ctx.service) for i in range(2)]
+        id_to_slot: dict = {}
+        uploads: list = []
+        for p in phones:
+            p.upload_agent()
+
+            def record_build(vals, agg_id, _orig=p.new_participations, **kw):
+                parts = _orig(vals, agg_id, **kw)
+                for v, part in zip(vals, parts):
+                    id_to_slot[part.id] = v[0]  # values[slot][0] == slot
+                return parts
+
+            def record_upload(parts, _orig=p.upload_participations):
+                t = time.perf_counter()
+                uploads.append((t, [id_to_slot[part.id] for part in parts]))
+                return _orig(parts)
+
+            p.new_participations = record_build
+            p.upload_participations = record_upload
+
+        values = [[i, 0, 1, 0] for i in range(n)]  # slot-identifying rows
+        cursor = {"index": 0, "t": 0.0, "t0": time.perf_counter()}
+        report = ingest_cohort(
+            phones, values, agg.id,
+            trace=trace, cursor=cursor, window=window, slack_s=slack,
+        )
+
+        seen = sorted(s for _, slots in uploads for s in slots)
+        assert seen == list(range(n)), "rows lost or duplicated in flight"
+        assert report.churned == sum(e.churned for e in schedule)
+
+        t0 = cursor["t0"]
+        churned_batches = []
+        last_live_batch = -1
+        for ix, (t, slots) in enumerate(uploads):
+            flags = {schedule[s].churned for s in slots}
+            assert len(flags) == 1, "a batch mixed live and churned rows"
+            if flags == {True}:
+                churned_batches.append(ix)
+                continue
+            last_live_batch = ix
+            for s in slots:
+                assert t >= t0 + schedule[s].at - slack - 1e-9, (
+                    f"slot {s} released {t0 + schedule[s].at - t:.4f}s early"
+                )
+        assert churned_batches and min(churned_batches) > last_live_batch, \
+            "churned rows must drain after every live row"
+        assert report.max_backlog_seen <= 4 * window
+
+        out = _reveal(recipient, clerks, agg)
+        expected = [sum(v[d] for v in values) % 433 for d in range(4)]
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_fault_storm_drains(tmp_path, monkeypatch):
+    """A 15% drop/e503 mix during the pipelined round: the retry plane
+    must land every micro-batch exactly once (batch replay is
+    idempotent), so the reveal stays exact."""
+    from sda_tpu.rest.client import SdaHttpClient
+    from sda_tpu.rest.server import serve_background
+    from sda_tpu.rest.tokenstore import TokenStore
+    from sda_tpu.server import new_mem_server
+
+    monkeypatch.setenv("SDA_REST_RETRIES", "8")
+    monkeypatch.setenv("SDA_REST_BACKOFF_BASE_S", "0.005")
+    monkeypatch.setenv("SDA_REST_BACKOFF_CAP_S", "0.2")
+    with serve_background(new_mem_server()) as url:
+        service = SdaHttpClient(url, TokenStore(str(tmp_path / "tokens")))
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, service, n_clerks=3
+        )
+        agg = _new_aggregation(
+            recipient, rkey, AdditiveSharing(share_count=3, modulus=433), "storm"
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        phones = [new_client(tmp_path / f"p{i}", service) for i in range(2)]
+        for p in phones:
+            p.upload_agent()
+        # the storm starts AFTER setup so it lands mid-ingest
+        monkeypatch.setenv("SDA_FAULTS", "drop=0.075,e503=0.075@0.01:17")
+        values = [[i % 7, i % 5, 1, i % 3] for i in range(16)]
+        trace = ArrivalTrace.from_text("base=400,churn=0.2:11")
+        cursor = {"index": 0, "t": 0.0, "t0": time.perf_counter()}
+        report = ingest_cohort(
+            phones, values, agg.id, trace=trace, cursor=cursor, window=4
+        )
+        assert report.rows == len(values)
+        out = _reveal(recipient, clerks, agg)
+        expected = [sum(v[d] for v in values) % 433 for d in range(4)]
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_bounded_backlog_under_burst(tmp_path, monkeypatch):
+    """A bursty trace lets the builder sprint far ahead of the release
+    clock; the in-flight window must still never exceed max_backlog."""
+    _configure(monkeypatch, "mem", False)
+    with with_service() as ctx:
+        recipient, rkey, clerks = new_committee_setup(
+            tmp_path, ctx.service, n_clerks=3
+        )
+        agg = _new_aggregation(
+            recipient, rkey, AdditiveSharing(share_count=3, modulus=433), "burst"
+        )
+        recipient.upload_aggregation(agg)
+        recipient.begin_aggregation(
+            agg.id, chosen_clerks=[c.agent.id for c in clerks]
+        )
+        phones = [new_client(tmp_path / f"p{i}", ctx.service) for i in range(3)]
+        for p in phones:
+            p.upload_agent()
+        values = [[i % 7, (i + 2) % 5, 1, 0] for i in range(30)]
+        trace = ArrivalTrace.from_text("base=30,burst=0.3@8,churn=0.1:9")
+        cursor = {"index": 0, "t": 0.0, "t0": time.perf_counter()}
+        report = ingest_cohort(
+            phones, values, agg.id,
+            trace=trace, cursor=cursor, window=4, max_backlog=8,
+        )
+        assert report.max_backlog_seen <= 8, \
+            f"backlog bound broke: saw {report.max_backlog_seen}"
+        assert report.windows == 8  # ceil(30 / 4)
+        out = _reveal(recipient, clerks, agg)
+        expected = [sum(v[d] for v in values) % 433 for d in range(4)]
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_knobs(monkeypatch):
+    """The two env knobs parse the documented grammar."""
+    monkeypatch.delenv("SDA_INGEST_PIPELINE", raising=False)
+    assert pipeline_enabled()
+    monkeypatch.setenv("SDA_INGEST_PIPELINE", "0")
+    assert not pipeline_enabled()
+
+    monkeypatch.delenv("SDA_ARRIVAL_SLACK_S", raising=False)
+    assert arrival_slack_s() == 0.05
+    monkeypatch.setenv("SDA_ARRIVAL_SLACK_S", "0.2")
+    assert arrival_slack_s() == 0.2
+    monkeypatch.setenv("SDA_ARRIVAL_SLACK_S", "-1")
+    assert arrival_slack_s() == 0.0  # clamped: a row may never leave late-proof
+    monkeypatch.setenv("SDA_ARRIVAL_SLACK_S", "soon")
+    with pytest.raises(ValueError):
+        arrival_slack_s()

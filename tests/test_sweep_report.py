@@ -375,6 +375,51 @@ def test_flagship_campaign_section(tmp_path, capsys):
     assert "grow-soak-20260806-010000.json" in out  # soak section variant
 
 
+def test_arrivals_ab_section(tmp_path, capsys):
+    _write(tmp_path, "flagship-20260807-010000.json",
+           {"kind": "flagship",
+            "topology": {"frontend_processes": 3, "shards": 2,
+                         "replicas": 2, "tiers": 2, "fanout": 4},
+            "certified_max_cohort": 512,
+            "ladder": [{"rung": 0, "cohort": 512, "round_s": 9.0,
+                        "certified": True, "ingest_pipeline": True}],
+            "arrivals_ab": {
+                "cohort": 512,
+                "legs": {
+                    "serial": {"arrivals_s": 14.6, "round_s": 22.1,
+                               "churned": 70, "exact": True,
+                               "flat_byte_match": True},
+                    "pipelined": {"arrivals_s": 5.2, "round_s": 12.7,
+                                  "churned": 70, "exact": True,
+                                  "flat_byte_match": True}},
+                "arrivals_pipeline_speedup": 2.8077},
+            "merged_samples": [{"t": 1.0, "procs": 2}],
+            "campaign_s": 60.0})
+    # a campaign without the A/B leg still rides the flagship table but
+    # contributes no arrivals row
+    _write(tmp_path, "flagship-20260806-090000.json",
+           {"kind": "flagship",
+            "topology": {"frontend_processes": 2, "shards": 2, "replicas": 2},
+            "certified_max_cohort": 256, "ladder": [],
+            "merged_samples": [], "campaign_s": 30.0})
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "arrivals ingest A/B" in out
+    assert "2.8077" in out          # the gated speedup ratio
+    assert "14.6" in out and "5.2" in out  # both legs' arrivals walls
+    assert "70/70" in out           # churn counts agree across legs
+    rows = [ln for ln in out.splitlines()
+            if "flagship-20260806-090000.json" in ln]
+    # the A/B-less campaign appears once (flagship table), not in the
+    # arrivals table
+    assert len(rows) == 1
+
+
 def test_sketch_rider_section(tmp_path, capsys):
     _write(tmp_path, "sketch-20260806-010000.json",
            {"metric": "sketch_accuracy",
