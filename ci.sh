@@ -106,40 +106,55 @@ echo "=== ci 3c/6: flagship smoke (tiers x shards x replicas, 2 OS processes) ==
 # ~30 s certified-cohort ladder over 2 sdad frontend processes sharing a
 # 2-shard R=2 store, sub-committees clerking as separate daemons: every
 # certified rung is byte-identical to a flat single-committee baseline.
-# The artifact must certify at least the opening rung and its merged
+# Runs TWICE — once pinned to the legacy serial tier close
+# (SDA_TIER_FANOUT=1) and once over the default sibling fan-out — and
+# both legs must certify with every rung exact + flat-matched, so a
+# fanout bug cannot pass by matching only its own dispatch mode. Each
+# artifact must certify at least the opening rung, bank the within-run
+# tier-close A/B (tier_close_fanout_speedup), and its merged
 # /v1/metrics series must prove the telemetry really spanned processes
 # (some bucket saw >= 2 frontends) — a single-process series passing
 # silently here would unwind the whole cross-process claim.
 FLAG_ART="$(mktemp -d)"
-JAX_PLATFORMS=cpu python scripts/flagship.py --smoke --artifacts "$FLAG_ART"
+SDA_TIER_FANOUT=1 JAX_PLATFORMS=cpu python scripts/flagship.py --smoke \
+    --artifacts "$FLAG_ART/serial"
+JAX_PLATFORMS=cpu python scripts/flagship.py --smoke \
+    --artifacts "$FLAG_ART/fanout"
 python - "$FLAG_ART" <<'EOF'
 import json, pathlib, sys
-arts = sorted(pathlib.Path(sys.argv[1]).glob("flagship-*.json"))
-assert len(arts) == 1, f"expected one flagship artifact, found {arts}"
-d = json.loads(arts[0].read_text())
-assert d["topology"]["frontend_processes"] >= 2, d["topology"]
-assert d["topology"]["shards"] >= 2 and d["topology"]["replicas"] >= 2
-assert d["certified_max_cohort"] >= 4, \
-    f"smoke ladder certified nothing: {d['certified_max_cohort']}"
-assert all(r["exact"] and r["flat_byte_match"] for r in d["ladder"]), \
-    "a ladder rung was not byte-identical to the flat baseline"
-# the arrival-pipelined ingest must actually be the path the smoke ran:
-# the artifact records the knob, every ladder rung must have taken it,
-# and the within-run serial-vs-pipelined arrivals ratio must be banked
-assert d.get("ingest_pipeline") is True, \
-    f"flagship smoke did not run the pipelined ingest: {d.get('ingest_pipeline')}"
-assert all(r.get("ingest_pipeline") for r in d["ladder"]), \
-    "a ladder rung fell back to the serial arrivals loop"
-ab = d.get("arrivals_ab") or {}
-assert isinstance(ab.get("arrivals_pipeline_speedup"), (int, float)), \
-    f"no arrivals A/B ratio banked: {ab}"
-merged = d.get("merged_samples") or []
-assert merged, "no merged cross-process telemetry series banked"
-peak = max(s.get("procs", 0) for s in merged)
-assert peak >= 2, f"merged series never saw both frontends (peak {peak})"
-print(f"ci: flagship certified cohort {d['certified_max_cohort']} "
-      f"({len(merged)} merged buckets, peak {peak} procs, "
-      f"arrivals speedup {ab['arrivals_pipeline_speedup']}x)")
+for leg in ("serial", "fanout"):
+    arts = sorted((pathlib.Path(sys.argv[1]) / leg).glob("flagship-*.json"))
+    assert len(arts) == 1, f"expected one {leg} flagship artifact, found {arts}"
+    d = json.loads(arts[0].read_text())
+    assert d["topology"]["frontend_processes"] >= 2, d["topology"]
+    assert d["topology"]["shards"] >= 2 and d["topology"]["replicas"] >= 2
+    assert d["certified_max_cohort"] >= 4, \
+        f"{leg} smoke ladder certified nothing: {d['certified_max_cohort']}"
+    assert all(r["exact"] and r["flat_byte_match"] for r in d["ladder"]), \
+        f"a {leg} ladder rung was not byte-identical to the flat baseline"
+    # the arrival-pipelined ingest must actually be the path the smoke
+    # ran: the artifact records the knob, every ladder rung must have
+    # taken it, and both within-run A/B ratios must be banked
+    assert d.get("ingest_pipeline") is True, \
+        f"{leg} smoke did not run the pipelined ingest: {d.get('ingest_pipeline')}"
+    assert all(r.get("ingest_pipeline") for r in d["ladder"]), \
+        f"a {leg} ladder rung fell back to the serial arrivals loop"
+    ab = d.get("arrivals_ab") or {}
+    assert isinstance(ab.get("arrivals_pipeline_speedup"), (int, float)), \
+        f"no arrivals A/B ratio banked in the {leg} leg: {ab}"
+    tab = d.get("tier_close_ab") or {}
+    assert isinstance(tab.get("tier_close_fanout_speedup"), (int, float)), \
+        f"no tier-close A/B ratio banked in the {leg} leg: {tab}"
+    merged = d.get("merged_samples") or []
+    assert merged, f"no merged cross-process telemetry series in the {leg} leg"
+    peak = max(s.get("procs", 0) for s in merged)
+    assert peak >= 2, \
+        f"{leg} merged series never saw both frontends (peak {peak})"
+    print(f"ci: flagship {leg} leg certified cohort "
+          f"{d['certified_max_cohort']} ({len(merged)} merged buckets, "
+          f"peak {peak} procs, arrivals speedup "
+          f"{ab['arrivals_pipeline_speedup']}x, tier-close fanout "
+          f"{tab['tier_close_fanout_speedup']}x)")
 EOF
 rm -rf "$FLAG_ART"
 

@@ -166,11 +166,17 @@ def _metrics_flagship(d: dict) -> dict:
     the same cohort, both rungs interleaved on the same host — like
     ``promote_reshare_speedup``, the ratio is drift-invariant and
     regresses exactly when the arrival pipeline stops beating the
-    per-phone loop."""
+    per-phone loop. ``tier_close_fanout_speedup`` is the same shape for
+    the tier-close dispatch: the SDA_TIER_FANOUT=1 leg's ``tier.close``
+    seconds over the default fanout leg's, so it regresses exactly when
+    fanning sibling-node closes out stops paying for its dispatch."""
     out = {}
     ab = d.get("arrivals_ab") if isinstance(d.get("arrivals_ab"), dict) else {}
     if isinstance(ab.get("arrivals_pipeline_speedup"), (int, float)):
         out["arrivals_pipeline_speedup"] = float(ab["arrivals_pipeline_speedup"])
+    tab = d.get("tier_close_ab") if isinstance(d.get("tier_close_ab"), dict) else {}
+    if isinstance(tab.get("tier_close_fanout_speedup"), (int, float)):
+        out["tier_close_fanout_speedup"] = float(tab["tier_close_fanout_speedup"])
     if isinstance(d.get("certified_max_cohort"), (int, float)) \
             and d["certified_max_cohort"] > 0:
         out["certified_max_cohort"] = float(d["certified_max_cohort"])

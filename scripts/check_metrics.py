@@ -88,6 +88,12 @@ REQUIRED_SERIES = [
     "sda_tier_depth",
     "sda_tier_reshare_seconds",
     "sda_tier_promote_seconds",
+    # tier-close dispatch: the reveal leg pins SDA_TIER_FANOUT=1 (serial
+    # mode label) and the reshare leg pins =2 (fanout mode label), so the
+    # per-level wall histogram shows with BOTH dispatch modes — asserted
+    # per label in main — plus the effective-width gauge
+    "sda_tier_close_seconds",
+    "sda_tier_fanout_nodes",
     # workload plane: drive_sketch_round completes one count-min round
     # through SketchQuery, which ticks the per-family round counter
     "sda_workload_rounds_total",
@@ -205,7 +211,22 @@ def drive_tier_round(base_url: str, tmp: str) -> None:
         service = SdaHttpClient(base_url, TokenStore(os.path.join(tmp, subdir)))
         return SdaClient(SdaClient.new_agent(keystore), keystore, service)
 
-    def run_leg(leg: str, sharing, expect_children_ready: bool) -> None:
+    def run_leg(leg: str, sharing, expect_children_ready: bool,
+                fanout_width: str) -> None:
+        # pin the dispatch width so the scrape carries BOTH mode labels
+        # of sda_tier_close_seconds: "1" takes the serial loop, "2" fans
+        # the two sibling nodes out
+        saved_fanout = os.environ.get("SDA_TIER_FANOUT")
+        os.environ["SDA_TIER_FANOUT"] = fanout_width
+        try:
+            _run_leg(leg, sharing, expect_children_ready)
+        finally:
+            if saved_fanout is None:
+                os.environ.pop("SDA_TIER_FANOUT", None)
+            else:
+                os.environ["SDA_TIER_FANOUT"] = saved_fanout
+
+    def _run_leg(leg: str, sharing, expect_children_ready: bool) -> None:
         recipient = new_client(f"tier-{leg}-recipient")
         rkey = recipient.new_encryption_key()
         recipient.upload_agent()
@@ -249,7 +270,7 @@ def drive_tier_round(base_url: str, tmp: str) -> None:
 
     # additive committees promote by reveal: every node clerks to a
     # result, so the whole tree reports ready
-    run_leg("reveal", AdditiveSharing(share_count=2, modulus=433), True)
+    run_leg("reveal", AdditiveSharing(share_count=2, modulus=433), True, "1")
     # Shamir committees share-promote: children never seal clerking
     # results (their columns climb as tagged participations), only the
     # root turns ready
@@ -257,6 +278,7 @@ def drive_tier_round(base_url: str, tmp: str) -> None:
         "reshare",
         BasicShamirSharing(share_count=2, privacy_threshold=1, prime_modulus=433),
         False,
+        "2",
     )
 
 
@@ -577,6 +599,14 @@ def main() -> int:
             errors.append(
                 f'sda_tier_promotions_total missing the path="{path}" label '
                 "(one tiered round per promotion path must be driven)"
+            )
+    for mode in ("serial", "fanout"):
+        if not re.search(
+            rf'^sda_tier_close_seconds_count\{{[^}}]*mode="{mode}"', body, re.M
+        ):
+            errors.append(
+                f'sda_tier_close_seconds missing the mode="{mode}" label '
+                "(the tier legs must pin SDA_TIER_FANOUT to 1 and 2)"
             )
 
     if errors:

@@ -74,6 +74,8 @@ sys.path.insert(0, str(REPO))
 
 import numpy as np  # noqa: E402
 
+from sda_tpu.utils.faults import Backoff  # noqa: E402
+
 DIM = 4
 MODULUS = 100003
 
@@ -657,15 +659,23 @@ def main() -> int:
                     rounds[-1]["grow"] = grow_info
                 if kill:
                     # healed: the repair thread must replay every hint
-                    # before the next round murders a different shard
+                    # before the next round murders a different shard;
+                    # polls back off full-jitter toward a 2s cap,
+                    # resetting while the queue is visibly draining
                     t0 = time.monotonic()
+                    backoff = Backoff(base=0.05, cap=2.0)
+                    last_depth = router.hint_depth()
                     while router.hint_depth() > 0:
                         if time.monotonic() - t0 > 30.0:
                             raise AssertionError(
                                 f"round {ix}: handoff queue stuck at "
                                 f"{router.hint_depth()}"
                             )
-                        time.sleep(0.05)
+                        depth = router.hint_depth()
+                        if depth < last_depth:
+                            backoff.reset()
+                        last_depth = depth
+                        backoff.sleep()
                     rounds[-1]["handoff_drain_s"] = round(
                         time.monotonic() - t0, 3
                     )

@@ -716,6 +716,61 @@ def print_arrivals_ab(rows) -> None:
         )
 
 
+def load_tier_close_ab(artdir: pathlib.Path):
+    """One row per flagship-*.json campaign carrying the within-run
+    tier-close A/B (scripts/flagship.py): the SDA_TIER_FANOUT=1 serial
+    and default-fanout post-ingest tier walls (all tier.* stages —
+    falling back to tier.close alone for older artifacts) at the same
+    cohort on the same live plane, the drift-immune
+    ``tier_close_fanout_speedup`` ratio bench_compare gates, the fanout
+    leg's lane occupancy, and both legs' exactness flags."""
+    rows = []
+    for f in sorted(artdir.glob("flagship-*.json")):
+        try:
+            d = json.loads(f.read_text())
+        except (OSError, ValueError):
+            continue
+        ab = d.get("tier_close_ab") if isinstance(d, dict) else None
+        if not isinstance(ab, dict):
+            continue
+        legs = ab.get("legs") if isinstance(ab.get("legs"), dict) else {}
+        serial = legs.get("serial") if isinstance(legs.get("serial"), dict) else {}
+        fan = legs.get("fanout") if isinstance(legs.get("fanout"), dict) else {}
+        rows.append(
+            {
+                "artifact": f.name,
+                "cohort": ab.get("cohort"),
+                "serial_s": serial.get("tier_s", serial.get("tier_close_s")),
+                "fanout_s": fan.get("tier_s", fan.get("tier_close_s")),
+                "speedup": ab.get("tier_close_fanout_speedup"),
+                "overlap": fan.get("overlap_efficiency"),
+                "exact": (
+                    serial.get("exact") and serial.get("flat_byte_match")
+                    and fan.get("exact") and fan.get("flat_byte_match")
+                ),
+            }
+        )
+    return rows
+
+
+def print_tier_close_ab(rows) -> None:
+    print("\ntier close A/B (serial vs fanned-out siblings, flagship-*.json):")
+    print(
+        f"{'cohort':>7} {'serial_s':>9} {'fanout_s':>9} {'speedup':>8} "
+        f"{'overlap':>8} {'exact':>5}  artifact"
+    )
+    for r in rows:
+        exact = "-" if r["exact"] is None else ("yes" if r["exact"] else "NO")
+        print(
+            f"{r['cohort'] if r['cohort'] is not None else '-':>7} "
+            f"{r['serial_s'] if r['serial_s'] is not None else '-':>9} "
+            f"{r['fanout_s'] if r['fanout_s'] is not None else '-':>9} "
+            f"{r['speedup'] if r['speedup'] is not None else '-':>8} "
+            f"{r['overlap'] if r['overlap'] is not None else '-':>8} "
+            f"{exact:>5}  {r['artifact']}"
+        )
+
+
 def load_sketch(artdir: pathlib.Path):
     """One row per sketch family per wire dimension per sketch-*.json
     artifact (bench.py's measure_sketch_accuracy): the accuracy-vs-
@@ -880,6 +935,7 @@ def main() -> int:
     soak_rows = load_soak(artdir)
     flagship_rows = load_flagship(artdir)
     arrivals_rows = load_arrivals_ab(artdir)
+    tier_close_rows = load_tier_close_ab(artdir)
     sketch_rows = load_sketch(artdir)
     scenario_cells, overhead_rows = load_scenarios(artdir)
     if (
@@ -954,6 +1010,8 @@ def main() -> int:
         print_flagship(flagship_rows)
     if arrivals_rows:
         print_arrivals_ab(arrivals_rows)
+    if tier_close_rows:
+        print_tier_close_ab(tier_close_rows)
     if sketch_rows:
         print_sketch(sketch_rows)
     if scenario_cells:

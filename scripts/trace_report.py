@@ -84,6 +84,15 @@ def print_report(trace_id: str, spans: list) -> None:
               f"{row['offset_s'] * 1000:>10.1f} {row['busy_s'] * 1000:>9.1f} "
               f"{row['share']:>6.2f}  |{bar:<{width}}|")
 
+    if report.get("tier_close"):
+        print("\ntier close levels (dispatch mode / lane occupancy):")
+        for row in report["tier_close"]:
+            eff = ("-" if row["overlap_efficiency"] is None
+                   else f"{row['overlap_efficiency']:.2f}")
+            print(f"  tier {row['tier']}: {row['nodes']} nodes, "
+                  f"mode={row['mode']} width={row['width']} "
+                  f"overlap={eff} in {row['duration_s'] * 1000:.1f} ms")
+
     print("\ncritical path (the span holding the wall clock at each moment):")
     for hop in report["critical_path"]:
         print(f"  +{hop['offset_s'] * 1000:>9.1f} ms  "

@@ -4,10 +4,11 @@ A committee round used to be driven round-robin — ``for c in clerks:
 c.run_chores(-1)`` — which serializes the whole committee on one core
 even though each clerk's job is independent and the hot loops (native
 sealed-box opens, chunk range GETs) release the GIL or block on the
-network. ``run_committee`` gives each clerk its own worker thread so
-committee wall time approaches the slowest member instead of the sum.
+network. ``run_committee`` dispatches each clerk as one task through
+``workpool.scatter`` (one worker per clerk) so committee wall time
+approaches the slowest member instead of the sum.
 
-Each worker rebinds the caller's trace id, so every clerk's job
+The scatter layer rebinds the caller's trace id, so every clerk's job
 processing still joins the same trace. Per-clerk results stay
 independent (distinct keys, distinct jobs, distinct HTTP sessions when
 each clerk has its own service proxy), so no cross-thread state is
@@ -17,9 +18,9 @@ thread-safe and shared deliberately (utils/workpool.py).
 
 from __future__ import annotations
 
-import threading
+import functools
 
-from .. import telemetry
+from ..utils import workpool
 
 
 def run_committee(clerks, max_iterations: int = -1) -> int:
@@ -28,44 +29,33 @@ def run_committee(clerks, max_iterations: int = -1) -> int:
     ``clerks`` is a sequence of clerk-capable clients (anything with
     ``clerk_once``); ``max_iterations`` follows ``run_chores`` semantics
     (negative = drain until no work is left). Returns the total number
-    of jobs processed across the committee. The first worker exception
-    is re-raised after all workers finish.
+    of jobs processed across the committee. The lowest-index worker
+    exception is re-raised after all workers finish (the drains are
+    never cancelled mid-committee — a half-drained clerk queue would
+    leave durable jobs in limbo).
     """
     clerks = list(clerks)
     if not clerks:
         return 0
-    counts = [0] * len(clerks)
-    errors: list = []
-    trace_id = telemetry.current_trace_id()
 
-    def drain(ix: int, clerk) -> None:
-        if trace_id:
-            telemetry.set_trace_id(trace_id)
-        try:
-            n = 0
-            if max_iterations < 0:
-                while clerk.clerk_once():
-                    n += 1
-            else:
-                for _ in range(max_iterations):
-                    if not clerk.clerk_once():
-                        break
-                    n += 1
-            counts[ix] = n
-        except BaseException as exc:  # noqa: BLE001 — re-raised below
-            errors.append(exc)
+    def drain(clerk) -> int:
+        n = 0
+        if max_iterations < 0:
+            while clerk.clerk_once():
+                n += 1
+        else:
+            for _ in range(max_iterations):
+                if not clerk.clerk_once():
+                    break
+                n += 1
+        return n
 
-    if len(clerks) == 1:  # no thread overhead for a committee of one
-        drain(0, clerks[0])
-    else:
-        workers = [
-            threading.Thread(target=drain, args=(ix, c), daemon=True)
-            for ix, c in enumerate(clerks)
-        ]
-        for w in workers:
-            w.start()
-        for w in workers:
-            w.join()
-    if errors:
-        raise errors[0]
-    return sum(counts)
+    outcomes = workpool.scatter(
+        "committee",
+        [functools.partial(drain, c) for c in clerks],
+        len(clerks),
+    )
+    for out in outcomes:
+        if out.error is not None:
+            raise out.error
+    return sum(out.value for out in outcomes)

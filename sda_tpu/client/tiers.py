@@ -47,6 +47,8 @@ the root reveals the exact sum of the survivors.
 
 from __future__ import annotations
 
+import functools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -56,6 +58,8 @@ import numpy as np
 from .. import telemetry
 from ..protocol import SdaError, TierReshare
 from ..protocol import tiers as tiers_mod
+from ..utils import workpool
+from ..utils.faults import Backoff
 from .committee import run_committee
 from .receive import RecipientOutput
 
@@ -66,8 +70,52 @@ from .receive import RecipientOutput
 # ``reshare`` it covers only the mask-correction row (and any epoch-1
 # re-issue), since the column expansion rides the clerk drain off the
 # driver's critical path (client/clerk.py, sda_tier_reshare_seconds).
+# Samples are observed on SUCCESS only: an aborted promotion (skipped
+# under ``strict=False``) must never drag the per-path averages the
+# ``promote_reshare_speedup`` gate compares.
 _PROMOTE_SERIES = "sda_tier_promote_seconds"
 _PROMOTE_HELP = "driver-side per-node tier promotion latency by path"
+
+# wall seconds spent closing+promoting one whole tier level, labelled by
+# dispatch mode — the serial-vs-fanout A/B series the flagship campaign
+# banks (scripts/flagship.py ``tier_close_ab``)
+_CLOSE_SERIES = "sda_tier_close_seconds"
+_CLOSE_HELP = "per-tier-level close+promote wall seconds by dispatch mode"
+_FANOUT_SERIES = "sda_tier_fanout_nodes"
+_FANOUT_HELP = "sibling-node tasks dispatched concurrently in the last tier level"
+
+
+def tier_fanout(nodes: int) -> int:
+    """Concurrent sibling-node width for one tier level.
+
+    ``SDA_TIER_FANOUT`` in the environment, else ``2 x`` the crypto
+    pool's worker count (``SDA_WORKERS`` / cpu count) — sibling closes
+    are REST round-trips plus server-side snapshot staging on *other*
+    processes, so the driver profitably holds more requests in flight
+    than it has cores. Always clamped to the node count;
+    ``SDA_TIER_FANOUT=1`` is the kill switch: ``run_tier_round`` takes
+    the exact legacy serial loop, bit for bit.
+    """
+    raw = os.environ.get("SDA_TIER_FANOUT")
+    if raw:
+        try:
+            width = max(1, int(raw))
+        except ValueError:
+            raise ValueError(
+                f"SDA_TIER_FANOUT must be an integer, got {raw!r}"
+            ) from None
+    else:
+        width = 2 * workpool.workers()
+    return max(1, min(nodes, width))
+
+
+def _poll_backoff(poll_interval: float) -> Backoff:
+    """Full-jitter schedule for the external-daemon poll loops — the
+    REST client's policy: start at the configured interval, double
+    toward a ~2 s idle cap, ``reset()`` whenever a poll observes
+    progress so an active tier drains at ``poll_interval`` cadence while
+    a stalled daemon is probed at most every couple of seconds."""
+    return Backoff(base=poll_interval, cap=max(2.0, poll_interval))
 
 
 @dataclass
@@ -252,8 +300,12 @@ def _await_results(entries, poll_interval: float, deadline: float) -> None:
     until its snapshot reports ``result_ready`` (results count reached
     the reconstruction threshold) — the exact condition the reveal
     needs. Raises TimeoutError past ``deadline`` so a dead daemon fails
-    the round loudly instead of spinning forever."""
+    the round loudly instead of spinning forever. Polls ride the shared
+    full-jitter :class:`Backoff` (reset whenever a node turns ready), so
+    a long wait on slow daemons converges to ~2 s probes instead of
+    hammering every ``poll_interval``."""
     waiting = list(entries)
+    backoff = _poll_backoff(poll_interval)
     while waiting:
         still = []
         for tn in waiting:
@@ -265,6 +317,8 @@ def _await_results(entries, poll_interval: float, deadline: float) -> None:
             )
             if not ready:
                 still.append(tn)
+        if len(still) < len(waiting):
+            backoff.reset()  # progress: stay at the base cadence
         waiting = still
         if not waiting:
             return
@@ -273,7 +327,7 @@ def _await_results(entries, poll_interval: float, deadline: float) -> None:
             raise TimeoutError(
                 f"external committees did not finish clerking: {ids}"
             )
-        time.sleep(poll_interval)
+        backoff.sleep()
 
 
 def _drain_clerks(entries, max_iterations: int) -> None:
@@ -355,6 +409,7 @@ def _await_promotions(
     for tn in entries:
         by_parent.setdefault(tn.node.parent, []).append(tn)
     waiting = {parent: len(children) * per_child for parent, children in by_parent.items()}
+    backoff = _poll_backoff(poll_interval)
     while waiting:
         done = []
         for parent_id, expected in waiting.items():
@@ -364,6 +419,8 @@ def _await_promotions(
                 done.append(parent_id)
         for parent_id in done:
             del waiting[parent_id]
+        if done:
+            backoff.reset()  # progress: stay at the base cadence
         if not waiting:
             return
         if time.monotonic() > deadline:
@@ -376,7 +433,39 @@ def _await_promotions(
                 for tn in by_parent[parent_id]:
                     skipped.append(tn.aggregation.id)
             return
-        time.sleep(poll_interval)
+        backoff.sleep()
+
+
+def _gather(entries, outcomes, strict: bool, skipped: list) -> list:
+    """Fold fanned-out per-node outcomes back into the serial loop's
+    exact semantics, in NODE-INDEX order regardless of completion order:
+    under ``strict`` the lowest-index failure re-raises (its outstanding
+    siblings were cancelled by the pool); otherwise failed nodes land in
+    ``skipped`` and the survivors come back in order."""
+    if strict:
+        for out in outcomes:
+            if out.error is not None:
+                raise out.error
+    live = []
+    for tn, out in zip(entries, outcomes):
+        if out.error is not None or out.cancelled:
+            skipped.append(tn.aggregation.id)
+        else:
+            live.append(tn)
+    return live
+
+
+def _note_overlap(span_record, outcomes, wall: float, width: int) -> None:
+    """Per-tier overlap efficiency onto the enclosing span's attrs —
+    busy task seconds over ``wall x width``, 1.0 meaning the fanned-out
+    siblings kept every lane busy the whole time. The flight recorder
+    (telemetry/flight.py ``round_report``) surfaces these per tier."""
+    if span_record is None or wall <= 0 or width <= 0:  # telemetry off
+        return
+    busy = sum(o.seconds for o in outcomes if not o.cancelled)
+    span_record["attrs"]["overlap_efficiency"] = round(
+        min(1.0, busy / (wall * width)), 4
+    )
 
 
 def run_tier_round(
@@ -424,6 +513,19 @@ def run_tier_round(
     children's expected promotion rows (children never turn
     ``result_ready`` on this path — their clerks submit upward instead
     of sealing clerking results).
+
+    Fanout contract: sibling nodes within one tier level are independent
+    (different sub-cohorts, different frontends under the placement
+    function), so their closes — and the reveal path's promotions — are
+    dispatched :func:`tier_fanout`-wide through ``workpool.scatter``.
+    Observable behaviour is unchanged from the serial loop: ``skipped``
+    and the live set are ordered by node index regardless of completion
+    order, a ``strict`` failure cancels outstanding siblings and
+    re-raises the lowest-index error, and ``SDA_TIER_FANOUT=1`` takes
+    the exact legacy serial loop. Each level's wall lands in
+    ``sda_tier_close_seconds{mode=serial|fanout}`` and the effective
+    width in ``sda_tier_fanout_nodes``; the ``tier.close`` span carries
+    the per-level ``overlap_efficiency``.
     """
     depth = tiers_mod.tier_depth(round.root)
     reshare = (
@@ -447,55 +549,100 @@ def run_tier_round(
     path_label = (
         tiers_mod.PROMOTION_RESHARE if reshare else tiers_mod.PROMOTION_REVEAL
     )
+
+    def _close_node(tn: TierRoundNode) -> None:
+        # closing the node (snapshot pipeline) is common to both paths
+        # and untimed; only the promotion work itself is observed, so
+        # the per-path samples compare like for like — and only on
+        # success, so an aborted promotion (skipped under strict=False)
+        # never leaves a sample
+        snapshot_id = tn.owner.end_aggregation(tn.aggregation.id)
+        if reshare:
+            t0 = time.perf_counter()
+            promote_mask_correction(
+                tn.owner,
+                tn.aggregation,
+                tn.node.parent,
+                snapshot_id=snapshot_id,
+            )
+            promote_hist.observe(time.perf_counter() - t0)
+
+    def _reveal_promote_node(tn: TierRoundNode) -> None:
+        t0 = time.perf_counter()
+        partial = tn.owner.reveal_aggregation(tn.aggregation.id).positive()
+        promote_partial(tn.owner, partial.values, tn.node.parent)
+        promote_hist.observe(time.perf_counter() - t0)
+
     for tier in range(depth - 1, 0, -1):
         entries = [tn for tn in round.nodes if tn.node.tier == tier]
+        width = tier_fanout(len(entries))
+        mode = "serial" if width <= 1 else "fanout"
+        close_hist = telemetry.histogram(_CLOSE_SERIES, _CLOSE_HELP, mode=mode)
+        telemetry.gauge(_FANOUT_SERIES, _FANOUT_HELP).set(width)
         live = []
+        t_level = time.perf_counter()
         with telemetry.span(
-            "tier.close", tier=tier, nodes=len(entries), path=path_label
-        ):
-            for tn in entries:
-                try:
-                    # closing the node (snapshot pipeline) is common to
-                    # both paths and untimed; only the promotion work
-                    # itself is observed, so the per-path samples
-                    # compare like for like
-                    snapshot_id = tn.owner.end_aggregation(tn.aggregation.id)
-                    if reshare:
-                        t0 = time.perf_counter()
-                        try:
-                            promote_mask_correction(
-                                tn.owner,
-                                tn.aggregation,
-                                tn.node.parent,
-                                snapshot_id=snapshot_id,
-                            )
-                        finally:
-                            promote_hist.observe(time.perf_counter() - t0)
-                except Exception:
-                    if strict:
-                        raise
-                    skipped.append(tn.aggregation.id)
-                    continue
-                live.append(tn)
-        with telemetry.span(
-            "tier.promote", tier=tier, nodes=len(live), path=path_label
-        ):
-            if not reshare:
-                _drain(live)
-                for tn in live:
-                    t0 = time.perf_counter()
+            "tier.close", tier=tier, nodes=len(entries), path=path_label,
+            mode=mode, width=width,
+        ) as close_span:
+            if width <= 1:
+                # SDA_TIER_FANOUT=1 kill switch: the legacy serial loop
+                for tn in entries:
                     try:
-                        partial = tn.owner.reveal_aggregation(
-                            tn.aggregation.id
-                        ).positive()
-                        promote_partial(tn.owner, partial.values, tn.node.parent)
+                        _close_node(tn)
                     except Exception:
                         if strict:
                             raise
                         skipped.append(tn.aggregation.id)
                         continue
-                    finally:
-                        promote_hist.observe(time.perf_counter() - t0)
+                    live.append(tn)
+            else:
+                # one close task per sibling node through a bounded
+                # pool: the round-trips and the server-side snapshot
+                # staging on different frontends overlap; a strict
+                # failure cancels the outstanding siblings before
+                # _gather re-raises it
+                t0 = time.perf_counter()
+                outcomes = workpool.scatter(
+                    "tier_close",
+                    [functools.partial(_close_node, tn) for tn in entries],
+                    width,
+                    cancel_on_error=strict,
+                )
+                _note_overlap(
+                    close_span, outcomes, time.perf_counter() - t0, width
+                )
+                live = _gather(entries, outcomes, strict, skipped)
+        with telemetry.span(
+            "tier.promote", tier=tier, nodes=len(live), path=path_label,
+            mode=mode, width=width,
+        ) as promote_span:
+            if not reshare:
+                _drain(live)
+                if width <= 1:
+                    for tn in live:
+                        try:
+                            _reveal_promote_node(tn)
+                        except Exception:
+                            if strict:
+                                raise
+                            skipped.append(tn.aggregation.id)
+                            continue
+                else:
+                    t0 = time.perf_counter()
+                    outcomes = workpool.scatter(
+                        "tier_promote",
+                        [
+                            functools.partial(_reveal_promote_node, tn)
+                            for tn in live
+                        ],
+                        width,
+                        cancel_on_error=strict,
+                    )
+                    _note_overlap(
+                        promote_span, outcomes, time.perf_counter() - t0, width
+                    )
+                    _gather(live, outcomes, strict, skipped)
             elif external_clerks:
                 _await_promotions(
                     round,
@@ -507,6 +654,12 @@ def run_tier_round(
                 )
             else:
                 _drain_clerks(live, max_iterations)
+                # the survivor re-issue check stays serial under fanout
+                # on purpose: the no-death fast path is a local length
+                # check, and the rare epoch-1 re-issue walks clerk
+                # clients a wrapped pool may share between siblings —
+                # concurrent re-issue through one clerk object is the
+                # only unsafe interleaving the fan-out could introduce
                 for tn in live:
                     t0 = time.perf_counter()
                     try:
@@ -516,8 +669,8 @@ def run_tier_round(
                             raise
                         skipped.append(tn.aggregation.id)
                         continue
-                    finally:
-                        promote_hist.observe(time.perf_counter() - t0)
+                    promote_hist.observe(time.perf_counter() - t0)
+        close_hist.observe(time.perf_counter() - t_level)
     with telemetry.span("tier.root_close", path=path_label):
         round.recipient.end_aggregation(round.root.id)
         _drain([round.nodes[0]])

@@ -206,6 +206,9 @@ def round_report(spans) -> dict:
     - ``stages`` — per-stage waterfall rows, ordered by first start:
       {stage, spans, offset_s, busy_s, span_s, share} where share is
       busy_s / wall_s;
+    - ``tier_close`` — one row per ``tier.close`` span: the level's
+      dispatch mode/width and the per-level ``overlap_efficiency`` the
+      fanned-out driver stamped on the span (client/tiers.py);
     - ``critical_path`` — {name, offset_s, duration_s} hops.
     """
     spans = _finished(spans)
@@ -217,6 +220,7 @@ def round_report(spans) -> dict:
             "span_s": 0.0,
             "overlap_efficiency": 0.0,
             "stages": [],
+            "tier_close": [],
             "critical_path": [],
         }
     t0 = spans[0]["start"]
@@ -252,6 +256,22 @@ def round_report(spans) -> dict:
             }
         )
 
+    tier_rows = []
+    for s in spans:
+        if s["name"] != "tier.close":
+            continue
+        attrs = s.get("attrs") or {}
+        tier_rows.append(
+            {
+                "tier": attrs.get("tier"),
+                "mode": attrs.get("mode"),
+                "width": attrs.get("width"),
+                "nodes": attrs.get("nodes"),
+                "overlap_efficiency": attrs.get("overlap_efficiency"),
+                "duration_s": round(s["duration_s"], 6),
+            }
+        )
+
     path = [
         {
             "name": s["name"],
@@ -269,6 +289,7 @@ def round_report(spans) -> dict:
         if span_sum > 0
         else 0.0,
         "stages": stage_rows,
+        "tier_close": tier_rows,
         "critical_path": path,
     }
 

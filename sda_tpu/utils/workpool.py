@@ -31,7 +31,8 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Sequence, TypeVar
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, TypeVar
 
 from .. import telemetry
 
@@ -131,3 +132,107 @@ def map_items(
             min(1.0, sum(busy) / (wall * n))
         )
     return out
+
+
+@dataclass
+class TaskOutcome:
+    """One :func:`scatter` task's result: exactly one of ``value`` /
+    ``error`` is meaningful unless the task was ``cancelled`` before it
+    ran (then both stay None). ``seconds`` is the task's busy time — the
+    per-lane numerator of the dispatch's overlap efficiency."""
+
+    value: object = None
+    error: Optional[BaseException] = None
+    seconds: float = 0.0
+    cancelled: bool = False
+
+
+def scatter(
+    op: str,
+    tasks: Sequence[Callable[[], object]],
+    width: int,
+    *,
+    cancel_on_error: bool = False,
+) -> List[TaskOutcome]:
+    """Run independent zero-arg ``tasks`` through a bounded pool of
+    ``width`` threads; returns one :class:`TaskOutcome` per task, in
+    task order regardless of completion order.
+
+    Unlike :func:`map_items` (contiguous sub-ranges of one kernel), this
+    is whole-task dispatch for heterogeneous work — per-node tier closes,
+    per-clerk committee drains — where each task blocks on its own I/O.
+    The caller's trace id is rebound into every worker, so all tasks'
+    spans join the dispatching round's trace.
+
+    ``cancel_on_error=True`` makes the first failing task cancel every
+    sibling that has not started yet (queued futures are cancelled AND
+    workers re-check before running); already-running siblings finish.
+    Failures never raise here — the caller inspects the outcomes so it
+    can keep strict re-raise / non-strict skip semantics deterministic.
+
+    A dedicated short-lived executor is used instead of the shared
+    crypto pool above: tasks routinely call back into :func:`map_items`,
+    and queueing them on the pool their own sub-ranges need is a
+    textbook nested-dispatch deadlock.
+
+    ``width <= 1`` (or a single task) runs everything inline on the
+    caller's thread in order — the serial path, bit for bit.
+    """
+    tasks = list(tasks)
+    outcomes = [TaskOutcome() for _ in tasks]
+    if not tasks:
+        return outcomes
+    width = max(1, min(width, len(tasks)))
+    task_hist = telemetry.histogram("sda_pool_task_seconds", _TASK_HELP, op=op)
+    stop = threading.Event()
+    trace_id = telemetry.current_trace_id()
+
+    def run(ix: int, task: Callable[[], object]) -> None:
+        if cancel_on_error and stop.is_set():
+            outcomes[ix].cancelled = True
+            return
+        if trace_id:
+            telemetry.set_trace_id(trace_id)
+        t0 = time.perf_counter()
+        try:
+            outcomes[ix].value = task()
+        except BaseException as exc:  # noqa: BLE001 — surfaced via outcome
+            outcomes[ix].error = exc
+            if cancel_on_error:
+                stop.set()
+        finally:
+            outcomes[ix].seconds = time.perf_counter() - t0
+            task_hist.observe(outcomes[ix].seconds)
+
+    if width <= 1 or len(tasks) <= 1:
+        for ix, task in enumerate(tasks):
+            run(ix, task)
+            if cancel_on_error and stop.is_set():
+                for rest in outcomes[ix + 1:]:
+                    rest.cancelled = True
+                break
+        return outcomes
+
+    wall0 = time.perf_counter()
+    with ThreadPoolExecutor(
+        max_workers=width, thread_name_prefix="sda-fanout"
+    ) as pool:
+        futures = [pool.submit(run, ix, t) for ix, t in enumerate(tasks)]
+        for ix, f in enumerate(futures):
+            try:
+                f.result()
+            except Exception:
+                # a future cancelled before its worker started
+                pass
+            if cancel_on_error and stop.is_set():
+                for rest in futures[ix + 1:]:
+                    rest.cancel()
+        for ix, f in enumerate(futures):
+            if f.cancelled():
+                outcomes[ix].cancelled = True
+    wall = time.perf_counter() - wall0
+    if wall > 0:
+        telemetry.gauge("sda_pool_utilization", _UTIL_HELP).set(
+            min(1.0, sum(o.seconds for o in outcomes) / (wall * width))
+        )
+    return outcomes

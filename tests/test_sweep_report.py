@@ -420,6 +420,57 @@ def test_arrivals_ab_section(tmp_path, capsys):
     assert len(rows) == 1
 
 
+def test_tier_close_ab_section(tmp_path, capsys):
+    _write(tmp_path, "flagship-20260807-020000.json",
+           {"kind": "flagship",
+            "topology": {"frontend_processes": 3, "shards": 2,
+                         "replicas": 2, "tiers": 2, "fanout": 4},
+            "certified_max_cohort": 512,
+            "ladder": [{"rung": 0, "cohort": 512, "round_s": 9.0,
+                        "certified": True, "ingest_pipeline": True}],
+            "tier_close_ab": {
+                "cohort": 512,
+                "legs": {
+                    # tier_s (all tier.* stages) is the compared wall;
+                    # tier_close_s rides along and must NOT be the one
+                    # printed when both are present
+                    "serial": {"tier_s": 2.18, "tier_close_s": 0.97,
+                               "round_s": 9.0,
+                               "overlap_efficiency": None, "exact": True,
+                               "flat_byte_match": True},
+                    "fanout": {"tier_s": 1.31, "tier_close_s": 1.02,
+                               "round_s": 7.9,
+                               "overlap_efficiency": 0.8614, "exact": True,
+                               "flat_byte_match": True}},
+                "tier_close_fanout_speedup": 1.6641},
+            "merged_samples": [{"t": 1.0, "procs": 2}],
+            "campaign_s": 60.0})
+    # a campaign without the tier A/B still rides the flagship table but
+    # contributes no tier-close row
+    _write(tmp_path, "flagship-20260806-080000.json",
+           {"kind": "flagship",
+            "topology": {"frontend_processes": 2, "shards": 2, "replicas": 2},
+            "certified_max_cohort": 256, "ladder": [],
+            "merged_samples": [], "campaign_s": 30.0})
+    old = sys.argv
+    sys.argv = ["sweep_report.py", str(tmp_path)]
+    try:
+        assert sweep_report.main() == 0
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out
+    assert "tier close A/B" in out
+    assert "1.6641" in out          # the gated speedup ratio
+    assert "2.18" in out and "1.31" in out  # both legs' tier walls
+    assert "0.97" not in out        # tier_s preferred over tier_close_s
+    assert "0.8614" in out          # the fanout leg's lane occupancy
+    rows = [ln for ln in out.splitlines()
+            if "flagship-20260806-080000.json" in ln]
+    # the A/B-less campaign appears once (flagship table), not in the
+    # tier-close table
+    assert len(rows) == 1
+
+
 def test_sketch_rider_section(tmp_path, capsys):
     _write(tmp_path, "sketch-20260806-010000.json",
            {"metric": "sketch_accuracy",

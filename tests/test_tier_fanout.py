@@ -1,0 +1,445 @@
+"""Concurrent tier close: the fanned-out sibling dispatch is invisible.
+
+The contract under test is the perf tentpole's: ``run_tier_round`` may
+close sibling nodes (and run the reveal path's promotions) through a
+bounded ``workpool.scatter`` pool, but observable behaviour must be
+bit-for-bit the legacy serial loop's — the root reveals the same bytes
+for every sharing scheme x promotion path x fan-out, ``skipped`` and
+the live set stay in node-index order regardless of completion order, a
+``strict`` failure cancels outstanding siblings and re-raises the
+lowest-index error, and ``SDA_TIER_FANOUT=1`` short-circuits to the
+serial loop (no scatter dispatch at all). Store and transport ride the
+usual env matrix (``with_service``: SDA_TEST_STORE x SDA_TEST_HTTP), so
+every cell here also runs over sqlite stores and the REST stack in CI.
+
+Also held: threshold survival (clerk-death epoch-1 re-issue) stays
+green under fanout, ``sda_tier_promote_seconds`` samples land on
+SUCCESS only, the ``sda_tier_close_seconds{mode=...}`` /
+``sda_tier_fanout_nodes`` instrumentation, the shared full-jitter
+poll-loop backoff schedule, ``scatter`` ordering/cancellation/trace
+semantics, and the flagship's overlapped flat-baseline control
+(``_FlatBaseline``: join + byte match + memo)."""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from sda_fixtures import with_service
+from sda_tpu import telemetry
+from sda_tpu.client import run_tier_round
+from sda_tpu.client.tiers import _poll_backoff, tier_fanout
+from sda_tpu.protocol import BasicShamirSharing
+from sda_tpu.protocol import tiers as tiers_mod
+from sda_tpu.utils import workpool
+from test_tiers import (
+    MODULUS,
+    SHARINGS,
+    VALUES,
+    _expected_sum,
+    _participate_all,
+    _setup_tiered,
+    _tiered_round,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# -- exactness: fanout reveals the serial bytes ------------------------------
+
+# {reveal, reshare} x {additive where legal, basic Shamir, packed}:
+# additive committees have no Lagrange structure, so reveal is their
+# only promotion path; the Shamir family covers both.
+CELLS = [
+    ("additive", None),
+    ("shamir", None),
+    ("shamir", "reveal"),
+    ("packed", None),
+    ("packed", "reveal"),
+]
+
+
+@pytest.mark.parametrize("m", [2, 3, 8])
+@pytest.mark.parametrize("scheme,promotion", CELLS)
+def test_fanout_reveal_matches_serial_bytes(scheme, promotion, m, tmp_path, monkeypatch):
+    """Every cell of the promotion matrix, fanned out: the root's bytes
+    equal the plain modular sum — the exact bytes the serial loop is
+    proven to reveal (test_tiers exactness matrix). m=8 over 5
+    participants leaves sub-cohorts empty, covering the zero-work
+    sibling under concurrent dispatch."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    with with_service() as ctx:
+        _, _, _, out = _tiered_round(
+            tmp_path, ctx.service, SHARINGS[scheme](), VALUES, tiers=2, m=m,
+            promotion=promotion,
+        )
+        assert out.values.tobytes() == _expected_sum(VALUES).tobytes()
+
+
+def test_fanout_and_serial_legs_byte_identical(tmp_path, monkeypatch):
+    """The A/B the flagship banks, in miniature: the same values through
+    a serial-pinned leg and a fanned-out leg reveal identical bytes."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "1")
+    with with_service() as ctx:
+        _, _, _, serial = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["shamir"](), VALUES, tiers=2, m=3,
+            tag="leg-serial",
+        )
+        monkeypatch.setenv("SDA_TIER_FANOUT", "8")
+        _, _, _, fanned = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["shamir"](), VALUES, tiers=2, m=3,
+            tag="leg-fanout",
+        )
+        assert fanned.values.tobytes() == serial.values.tobytes()
+        assert fanned.values.tobytes() == _expected_sum(VALUES).tobytes()
+
+
+def test_three_tier_fanout_exact(tmp_path, monkeypatch):
+    """Depth recursion under fanout: tiers=3, m=2 — two fanned-out
+    levels of promotions climbing — still the exact flat sum."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    with with_service() as ctx:
+        _, _, _, out = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=3, m=2,
+        )
+        assert out.values.tobytes() == _expected_sum(VALUES).tobytes()
+
+
+# -- SDA_TIER_FANOUT=1 is the kill switch ------------------------------------
+
+
+def _recording_scatter(monkeypatch):
+    ops = []
+    real = workpool.scatter
+
+    def wrapper(op, tasks, width, **kwargs):
+        ops.append(op)
+        return real(op, tasks, width, **kwargs)
+
+    monkeypatch.setattr(workpool, "scatter", wrapper)
+    return ops
+
+
+def test_fanout_one_takes_the_serial_loop(tmp_path, monkeypatch):
+    """``SDA_TIER_FANOUT=1`` must short-circuit to the legacy serial
+    loop: no tier_close/tier_promote scatter dispatch at all (the
+    in-proc committee drain's own "committee" dispatch is unrelated and
+    expected either way)."""
+    ops = _recording_scatter(monkeypatch)
+    monkeypatch.setenv("SDA_TIER_FANOUT", "1")
+    with with_service() as ctx:
+        _, _, _, out = _tiered_round(
+            tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=2, m=2,
+        )
+        assert out.values.tobytes() == _expected_sum(VALUES).tobytes()
+    assert "tier_close" not in ops and "tier_promote" not in ops
+
+
+def test_fanout_dispatches_through_scatter(tmp_path, monkeypatch):
+    """The positive control: with width > 1 the reveal path dispatches
+    both the closes and the promotions through the pool."""
+    ops = _recording_scatter(monkeypatch)
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    with with_service() as ctx:
+        _tiered_round(
+            tmp_path, ctx.service, SHARINGS["additive"](), VALUES, tiers=2, m=3,
+        )
+    assert "tier_close" in ops and "tier_promote" in ops
+
+
+# -- failure semantics under fanout ------------------------------------------
+
+
+def test_fanout_skip_accounting_order_stable(tmp_path, monkeypatch):
+    """Two vanished sub-aggregations under ``strict=False``: ``skipped``
+    comes back in NODE-INDEX order regardless of which fanned-out close
+    failed first, and the root reveals the exact survivor sum."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    with with_service() as ctx:
+        round, agg = _setup_tiered(
+            tmp_path, ctx.service, SHARINGS["additive"](), tiers=2, m=3,
+        )
+        participants = _participate_all(tmp_path, ctx.service, agg, VALUES)
+        lost_lo, lost_hi = round.nodes[1], round.nodes[3]
+        lost_lo.owner.delete_aggregation(lost_lo.aggregation.id)
+        lost_hi.owner.delete_aggregation(lost_hi.aggregation.id)
+        result = run_tier_round(round, strict=False)
+        assert result.skipped == [
+            lost_lo.aggregation.id, lost_hi.aggregation.id,
+        ]
+        lost = {lost_lo.aggregation.id, lost_hi.aggregation.id}
+        survivors = [
+            v
+            for p, v in zip(participants, VALUES)
+            if tiers_mod.leaf_aggregation_id(agg, p.agent.id) not in lost
+        ]
+        assert list(result.output.positive().values) == [
+            sum(v[d] for v in survivors) % MODULUS for d in range(len(VALUES[0]))
+        ]
+
+
+def test_fanout_strict_failure_is_loud(tmp_path, monkeypatch):
+    """A vanished sub-aggregation under ``strict=True`` still raises
+    when its close runs on a pool thread — the outcome's error is
+    re-raised on the driver, siblings cancelled."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    with with_service() as ctx:
+        round, agg = _setup_tiered(
+            tmp_path, ctx.service, SHARINGS["additive"](), tiers=2, m=3,
+        )
+        _participate_all(tmp_path, ctx.service, agg, VALUES)
+        lost = round.nodes[1]
+        lost.owner.delete_aggregation(lost.aggregation.id)
+        with pytest.raises(Exception):
+            run_tier_round(round, strict=True)
+
+
+def test_clerk_death_epoch1_reissue_under_fanout(tmp_path, monkeypatch):
+    """Cross-tier threshold survival composes with the fan-out: kill one
+    leaf clerk after ingest, and the strict fanned-out round still
+    re-issues over the survivors (epoch 1) and reveals the exact sum."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    sharing = BasicShamirSharing(
+        share_count=3, privacy_threshold=1, prime_modulus=MODULUS
+    )
+    with with_service() as ctx:
+        round, agg = _setup_tiered(
+            tmp_path, ctx.service, sharing, tiers=2, m=2, disjoint=True
+        )
+        _participate_all(tmp_path, ctx.service, agg, VALUES)
+        victim = round.nodes[1]
+        victim.clerks = victim.clerks[1:]  # never drained again
+        result = run_tier_round(round, strict=True)
+        assert result.skipped == []
+        assert (
+            result.output.positive().values.tobytes()
+            == _expected_sum(VALUES).tobytes()
+        )
+
+
+# -- telemetry: success-only samples, mode labels, overlap -------------------
+
+
+def _hist(snap, name, **labels):
+    for h in snap["histograms"]:
+        if h["name"] == name and all(
+            h["labels"].get(k) == v for k, v in labels.items()
+        ):
+            return h
+    return None
+
+
+def test_promote_samples_on_success_only_and_close_mode_labels(tmp_path, monkeypatch):
+    """Below-threshold clerk death under ``strict=False``: the victim's
+    failed re-issue must leave NO ``sda_tier_promote_seconds`` sample
+    (the observe-in-finally double-count regression) — exactly three
+    land: two mask corrections plus the one surviving re-share check.
+    The same round's level wall lands in
+    ``sda_tier_close_seconds{mode=fanout}`` with the width gauge set and
+    the tier.close span carrying the lane-occupancy attr."""
+    monkeypatch.setenv("SDA_TIER_FANOUT", "4")
+    sharing = BasicShamirSharing(
+        share_count=3, privacy_threshold=1, prime_modulus=MODULUS
+    )
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with with_service() as ctx:
+            round, agg = _setup_tiered(
+                tmp_path, ctx.service, sharing, tiers=2, m=2, disjoint=True
+            )
+            _participate_all(tmp_path, ctx.service, agg, VALUES)
+            victim = round.nodes[1]
+            victim.clerks = victim.clerks[:1]  # below reconstruction threshold
+            result = run_tier_round(round, strict=False)
+            assert result.skipped == [victim.aggregation.id]
+            snap = telemetry.snapshot(include_spans=0)
+            promote = _hist(
+                snap, "sda_tier_promote_seconds",
+                path=tiers_mod.PROMOTION_RESHARE,
+            )
+            assert promote is not None and promote["count"] == 3
+            close = _hist(snap, "sda_tier_close_seconds", mode="fanout")
+            assert close is not None and close["count"] == 1
+            assert _hist(snap, "sda_tier_close_seconds", mode="serial") is None
+            widths = [
+                g["value"] for g in snap["gauges"]
+                if g["name"] == "sda_tier_fanout_nodes"
+            ]
+            assert widths == [2]
+            close_spans = telemetry.spans(name="tier.close")
+            assert close_spans, "tier.close span should be recorded"
+            attrs = close_spans[-1].get("attrs", {})
+            assert attrs.get("mode") == "fanout" and attrs.get("width") == 2
+            assert 0.0 < attrs.get("overlap_efficiency", -1.0) <= 1.0
+    finally:
+        telemetry.reset()
+        telemetry.set_enabled(was)
+
+
+# -- tier_fanout / poll backoff units ----------------------------------------
+
+
+def test_tier_fanout_env_and_default(monkeypatch):
+    monkeypatch.setenv("SDA_TIER_FANOUT", "6")
+    assert tier_fanout(10) == 6
+    assert tier_fanout(4) == 4  # clamped to the node count
+    assert tier_fanout(0) == 1  # degenerate level still yields a width
+    monkeypatch.setenv("SDA_TIER_FANOUT", "0")
+    assert tier_fanout(5) == 1  # floor: the kill switch, not an error
+    monkeypatch.setenv("SDA_TIER_FANOUT", "many")
+    with pytest.raises(ValueError):
+        tier_fanout(5)
+    monkeypatch.delenv("SDA_TIER_FANOUT")
+    monkeypatch.setenv("SDA_WORKERS", "3")
+    assert tier_fanout(100) == 6  # default: 2 x the crypto pool width
+    assert tier_fanout(2) == 2
+
+
+def test_poll_backoff_schedule():
+    """The shared drain-loop schedule: full jitter doubling from the
+    configured poll interval to a ~2 s idle cap, reset() restoring the
+    base cadence, floors honoured."""
+    b = _poll_backoff(0.1)
+    ceilings = []
+    for _ in range(7):
+        ceilings.append(b.ceiling())
+        delay = b.next_delay()
+        assert 0.0 <= delay <= ceilings[-1]
+    assert ceilings == pytest.approx([0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0])
+    b.reset()
+    assert b.ceiling() == pytest.approx(0.1)
+    assert b.next_delay(floor=3.0) == 3.0  # Retry-After style floor wins
+    # an interval beyond the cap keeps polling at its own cadence
+    assert _poll_backoff(5.0).cap == 5.0
+
+
+# -- scatter primitive -------------------------------------------------------
+
+
+def test_scatter_outcomes_in_task_order():
+    """Completion order is scrambled (later tasks finish first); the
+    outcomes still come back in task order with per-task busy time."""
+    import time as _time
+
+    def make(i):
+        def task():
+            _time.sleep((4 - i) * 0.01)
+            return i
+        return task
+
+    outcomes = workpool.scatter("test_order", [make(i) for i in range(5)], 4)
+    assert [o.value for o in outcomes] == list(range(5))
+    assert all(o.error is None and not o.cancelled for o in outcomes)
+    assert all(o.seconds >= 0.0 for o in outcomes)
+
+
+def test_scatter_width_one_runs_inline():
+    """The serial path, bit for bit: width<=1 never leaves the caller's
+    thread."""
+    names = []
+    outcomes = workpool.scatter(
+        "test_inline",
+        [lambda: names.append(threading.current_thread().name) or "ok"] * 3,
+        1,
+    )
+    assert [o.value for o in outcomes] == ["ok"] * 3
+    assert names == [threading.current_thread().name] * 3
+
+
+def test_scatter_rebinds_trace_id():
+    """Worker tasks join the dispatching round's trace."""
+    orig = telemetry.current_trace_id()
+    telemetry.set_trace_id("fanout-test-trace")
+    try:
+        outcomes = workpool.scatter(
+            "test_trace", [telemetry.current_trace_id] * 4, 2
+        )
+        assert [o.value for o in outcomes] == ["fanout-test-trace"] * 4
+    finally:
+        telemetry.set_trace_id(orig)
+
+
+def test_scatter_strict_failure_cancels_pending_siblings():
+    """cancel_on_error: the first failure stops the queue — the
+    already-running sibling finishes, every not-yet-started task comes
+    back cancelled (never executed), and the failure is surfaced on its
+    own outcome rather than raised."""
+    started, release = threading.Event(), threading.Event()
+    ran = []
+
+    def fail():
+        assert started.wait(5), "sibling should be running before the failure"
+        release.set()
+        raise RuntimeError("boom")
+
+    def block():
+        started.set()
+        assert release.wait(5)
+        return "ran"
+
+    def never():
+        ran.append(1)
+        return "should-not-run"
+
+    tasks = [fail, block] + [never] * 4
+    outcomes = workpool.scatter("test_cancel", tasks, 2, cancel_on_error=True)
+    assert isinstance(outcomes[0].error, RuntimeError)
+    assert outcomes[1].value == "ran" and not outcomes[1].cancelled
+    for out in outcomes[2:]:
+        assert out.cancelled and out.value is None and out.error is None
+    assert ran == []
+
+
+# -- the flagship's overlapped flat-baseline control -------------------------
+
+
+@pytest.fixture(scope="module")
+def flagship():
+    spec = importlib.util.spec_from_file_location(
+        "flagship_for_test", REPO / "scripts" / "flagship.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flat_baseline_overlap_joins_matches_and_memoizes(flagship, monkeypatch):
+    """_FlatBaseline runs the flat control on a background thread:
+    result() joins and returns the exact flat bytes; a second
+    construction for the same (rung, cohort, workload) is a memo hit
+    (no thread, no recompute); a worker failure is re-raised at join."""
+    import numpy as np
+
+    values = [[1, 2, 3, 4], [5, 6, 7, 8]]
+    expected = np.array([6, 8, 10, 12], dtype=np.int64).tobytes()
+    ctx = {"workload": "dense"}
+    fb = flagship._FlatBaseline(0, 2, ctx, values)
+    assert fb._thread is not None  # overlapped, not inline
+    assert fb.result() == expected
+    assert fb._thread is None  # joined
+    assert ctx["baseline_memo"][(0, 2, "dense")] == expected
+
+    # memo hit: flat_baseline must NOT run again for the same key
+    def explode(_values):
+        raise AssertionError("memoized baseline recomputed")
+
+    monkeypatch.setattr(flagship, "flat_baseline", explode)
+    again = flagship._FlatBaseline(0, 2, ctx, values)
+    assert again._thread is None and again.result() == expected
+
+    # a fresh key does recompute — and the worker's error surfaces at join
+    def boom(_values):
+        raise RuntimeError("baseline failed")
+
+    monkeypatch.setattr(flagship, "flat_baseline", boom)
+    failing = flagship._FlatBaseline(1, 2, ctx, values)
+    with pytest.raises(RuntimeError, match="baseline failed"):
+        failing.result()
